@@ -1,0 +1,63 @@
+"""Key hashing.
+
+The rate-limit identity is the string ``name + "_" + unique_key``
+(reference: gubernator.go › GetRateLimits).  We hash it once on the host
+to a 64-bit value that serves both purposes the reference splits between
+`hash.go` (peer picking) and the LRU map (row lookup):
+
+- upper bits pick the shard (chip) — the consistent-hash-range analog,
+- the full hash probes the device-resident open-addressing table.
+
+FNV-1a 64 is used like the reference's default fnv1 hash (hash.go ›
+ConsistantHash — reconstructed); any 64-bit hash works since both sides
+only need determinism + uniformity.  Hash value 0 is remapped to 1: row 0
+of the device table is reserved and key 0 is the empty-slot sentinel.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+# Optional C fast path (gubernator_tpu/ops/native); resolved once at import.
+try:
+    from gubernator_tpu.ops import native as _native  # type: ignore
+except ImportError:
+    _native = None
+
+
+def fnv1a64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def hash_key(name: str, unique_key: str) -> int:
+    """64-bit identity hash of a rate limit, never 0."""
+    h = fnv1a64((name + "_" + unique_key).encode("utf-8"))
+    return h if h != 0 else 1
+
+
+def hash_keys(keys: Sequence[str]) -> np.ndarray:
+    """Batch hash → uint64[len(keys)], never 0."""
+    if _native is not None:
+        return _native.hash_keys(keys)
+    out = np.empty(len(keys), dtype=np.uint64)
+    for i, k in enumerate(keys):
+        h = fnv1a64(k.encode("utf-8"))
+        out[i] = h if h != 0 else 1
+    return out
+
+
+def shard_of(key_hash: np.ndarray | int, num_shards: int) -> np.ndarray | int:
+    """Shard index by hash range (top 32 bits), the consistent-hash-range
+    analog of hash.go › ConsistantHash.Get.  Stable under fixed
+    num_shards; re-sharding on membership change re-maps ranges
+    (SURVEY.md §2.3).  Single formula for scalar and array paths:
+    ``((h >> 32) * n) >> 32``."""
+    if isinstance(key_hash, (int, np.integer)):
+        return int(((int(key_hash) >> 32) * num_shards) >> 32)
+    kh = key_hash.astype(np.uint64)
+    return ((kh >> np.uint64(32)) * np.uint64(num_shards) >> np.uint64(32)).astype(np.int32)

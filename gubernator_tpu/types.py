@@ -1,0 +1,142 @@
+"""Wire-level types for the TPU-native gubernator framework.
+
+These mirror the reference wire contract (SURVEY.md §2.4; reference
+`proto/gubernator.proto` › Algorithm/Status/Behavior/RateLimitReq/
+RateLimitResp — reconstructed, the reference mount was empty).  They are
+plain Python enums/dataclasses so the core framework works without
+protobuf; the gRPC front door converts to/from the generated pb2 classes.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class Algorithm(enum.IntEnum):
+    """reference: gubernator.proto › Algorithm."""
+
+    TOKEN_BUCKET = 0
+    LEAKY_BUCKET = 1
+
+
+class Status(enum.IntEnum):
+    """reference: gubernator.proto › Status."""
+
+    UNDER_LIMIT = 0
+    OVER_LIMIT = 1
+
+
+class Behavior(enum.IntFlag):
+    """reference: gubernator.proto › Behavior (bit flags).
+
+    BATCHING is the zero value (default behavior), as in the reference.
+    """
+
+    BATCHING = 0
+    NO_BATCHING = 1
+    GLOBAL = 2
+    DURATION_IS_GREGORIAN = 4
+    RESET_REMAINING = 8
+    MULTI_REGION = 16
+    DRAIN_OVER_LIMIT = 32
+
+
+class GregorianDuration(enum.IntEnum):
+    """Calendar periods for DURATION_IS_GREGORIAN.
+
+    When Behavior.DURATION_IS_GREGORIAN is set, RateLimitRequest.duration
+    holds one of these ordinals instead of milliseconds; the bucket expires
+    at the end of the current calendar period (reference: holster gregorian
+    helpers used by algorithms.go › tokenBucket).
+    """
+
+    MINUTES = 0
+    HOURS = 1
+    DAYS = 2
+    WEEKS = 3
+    MONTHS = 4
+    YEARS = 5
+
+
+#: reference: gubernator.go › maxBatchSize
+MAX_BATCH_SIZE = 1000
+
+#: Millisecond durations for the fixed-width Gregorian periods (used for
+#: leak-rate math; actual expiry is computed on the calendar).
+GREGORIAN_APPROX_MS = {
+    GregorianDuration.MINUTES: 60_000,
+    GregorianDuration.HOURS: 3_600_000,
+    GregorianDuration.DAYS: 86_400_000,
+    GregorianDuration.WEEKS: 7 * 86_400_000,
+    GregorianDuration.MONTHS: 30 * 86_400_000,
+    GregorianDuration.YEARS: 365 * 86_400_000,
+}
+
+
+@dataclass
+class RateLimitRequest:
+    """reference: gubernator.proto › RateLimitReq.
+
+    Identity of a rate limit is ``hash(name + "_" + unique_key)``
+    (reference: gubernator.go › GetRateLimits key construction).
+    """
+
+    name: str = ""
+    unique_key: str = ""
+    hits: int = 1
+    limit: int = 0
+    duration: int = 0  # milliseconds, or GregorianDuration ordinal
+    algorithm: Algorithm = Algorithm.TOKEN_BUCKET
+    behavior: Behavior = Behavior.BATCHING
+    burst: int = 0  # 0 → defaults to limit (leaky bucket only)
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return self.name + "_" + self.unique_key
+
+
+@dataclass
+class RateLimitResponse:
+    """reference: gubernator.proto › RateLimitResp."""
+
+    status: Status = Status.UNDER_LIMIT
+    limit: int = 0
+    remaining: int = 0
+    reset_time: int = 0  # epoch ms
+    error: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class GetRateLimitsRequest:
+    """reference: gubernator.proto › GetRateLimitsReq."""
+
+    requests: List[RateLimitRequest] = field(default_factory=list)
+
+
+@dataclass
+class GetRateLimitsResponse:
+    """reference: gubernator.proto › GetRateLimitsResp."""
+
+    responses: List[RateLimitResponse] = field(default_factory=list)
+
+
+@dataclass
+class PeerInfo:
+    """reference: peers.proto / config.go › PeerInfo."""
+
+    grpc_address: str = ""
+    http_address: str = ""
+    datacenter: str = ""
+    is_owner: bool = False
+
+
+@dataclass
+class HealthCheckResponse:
+    """reference: gubernator.proto › HealthCheckResp."""
+
+    status: str = "healthy"  # "healthy" | "unhealthy"
+    message: str = ""
+    peer_count: int = 0

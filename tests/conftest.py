@@ -1,0 +1,12 @@
+"""Test config: force an 8-device CPU platform (the reference's
+cluster/cluster.go in-process multi-daemon analog, SURVEY.md §4) and
+enable x64 before jax initializes."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

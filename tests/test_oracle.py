@@ -1,0 +1,291 @@
+"""M0 oracle unit tests — the §2.4 behavior contract, mirroring the
+reference's functional tests (functional_test.go › TestTokenBucket,
+TestLeakyBucket, TestOverTheLimit, TestChangeLimit, TestResetRemaining,
+TestTokenBucketGregorian — reconstructed)."""
+import datetime as dt
+
+import pytest
+
+from gubernator_tpu import (
+    Algorithm,
+    Behavior,
+    GregorianDuration,
+    Oracle,
+    RateLimitRequest,
+    Status,
+)
+from gubernator_tpu.gregorian import gregorian_expiration
+
+NOW = 1_760_000_000_000  # fixed epoch ms
+
+
+def req(**kw):
+    defaults = dict(name="test", unique_key="k", hits=1, limit=10,
+                    duration=60_000, algorithm=Algorithm.TOKEN_BUCKET)
+    defaults.update(kw)
+    return RateLimitRequest(**defaults)
+
+
+class TestTokenBucket:
+    def test_basic_decrement(self):
+        o = Oracle()
+        for i in range(10):
+            r = o.check(req(), NOW + i)
+            assert r.status == Status.UNDER_LIMIT
+            assert r.remaining == 9 - i
+            assert r.limit == 10
+            assert r.reset_time == NOW + 60_000
+
+    def test_over_limit_no_decrement(self):
+        o = Oracle()
+        o.check(req(hits=10), NOW)
+        r = o.check(req(hits=1), NOW + 1)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 0
+        # remaining unchanged by further over-limit hits
+        r = o.check(req(hits=5), NOW + 2)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 0
+
+    def test_partial_over_limit_keeps_remaining(self):
+        o = Oracle()
+        o.check(req(hits=7), NOW)  # remaining 3
+        r = o.check(req(hits=5), NOW + 1)  # 5 > 3 → OVER, no decrement
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 3
+        r = o.check(req(hits=3), NOW + 2)  # still fits
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 0
+
+    def test_expiry_resets(self):
+        o = Oracle()
+        o.check(req(hits=10), NOW)
+        r = o.check(req(hits=1), NOW + 60_000)  # exactly at expire_at
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 9
+        assert r.reset_time == NOW + 120_000
+
+    def test_first_request_over_limit(self):
+        o = Oracle()
+        r = o.check(req(hits=11), NOW)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 10  # fresh bucket not drained
+
+    def test_hits_zero_is_pure_query(self):
+        o = Oracle()
+        o.check(req(hits=3), NOW)
+        r = o.check(req(hits=0), NOW + 1)
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 7
+        # after an over-limit event the stored status is returned
+        o.check(req(hits=100), NOW + 2)
+        r = o.check(req(hits=0), NOW + 3)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 7
+
+    def test_change_limit_in_place(self):
+        # functional_test.go › TestChangeLimit semantics
+        o = Oracle()
+        o.check(req(hits=1, limit=100), NOW)  # remaining 99
+        r = o.check(req(hits=1, limit=50), NOW + 1)
+        assert r.limit == 50
+        assert r.remaining == 48  # 99 + (50-100) = 49, minus this hit
+        r = o.check(req(hits=1, limit=200), NOW + 2)
+        assert r.limit == 200
+        assert r.remaining == 197
+
+    def test_change_limit_clamps_at_zero(self):
+        o = Oracle()
+        o.check(req(hits=90, limit=100), NOW)  # remaining 10
+        r = o.check(req(hits=0, limit=5), NOW + 1)
+        assert r.remaining == 0  # 10 + (5-100) → clamped
+
+    def test_change_duration_in_place(self):
+        o = Oracle()
+        o.check(req(hits=1), NOW)
+        r = o.check(req(hits=1, duration=120_000), NOW + 1)
+        assert r.reset_time == NOW + 120_000
+        assert r.remaining == 8  # state preserved
+
+    def test_change_duration_expiring_now_resets(self):
+        o = Oracle()
+        o.check(req(hits=5), NOW)
+        # shrink duration so created+dur <= now → fresh bucket
+        r = o.check(req(hits=1, duration=10), NOW + 50)
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 9
+
+    def test_reset_remaining(self):
+        o = Oracle()
+        o.check(req(hits=10), NOW)
+        r = o.check(req(hits=1, behavior=Behavior.RESET_REMAINING), NOW + 1)
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 9
+
+    def test_drain_over_limit(self):
+        o = Oracle()
+        o.check(req(hits=7), NOW)  # remaining 3
+        r = o.check(req(hits=5, behavior=Behavior.DRAIN_OVER_LIMIT), NOW + 1)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 0  # drained
+
+    def test_zero_limit(self):
+        o = Oracle()
+        r = o.check(req(hits=1, limit=0), NOW)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 0
+
+
+class TestGregorian:
+    def test_minute_boundary(self):
+        # 2026-01-15 10:30:30 UTC
+        now = int(dt.datetime(2026, 1, 15, 10, 30, 30, tzinfo=dt.timezone.utc)
+                  .timestamp() * 1000)
+        end = gregorian_expiration(now, GregorianDuration.MINUTES)
+        assert end == int(dt.datetime(2026, 1, 15, 10, 31, tzinfo=dt.timezone.utc)
+                          .timestamp() * 1000)
+
+    def test_month_boundary(self):
+        now = int(dt.datetime(2026, 2, 10, tzinfo=dt.timezone.utc).timestamp() * 1000)
+        end = gregorian_expiration(now, GregorianDuration.MONTHS)
+        assert end == int(dt.datetime(2026, 3, 1, tzinfo=dt.timezone.utc)
+                          .timestamp() * 1000)
+
+    def test_week_starts_monday(self):
+        # 2026-01-15 is a Thursday
+        now = int(dt.datetime(2026, 1, 15, tzinfo=dt.timezone.utc).timestamp() * 1000)
+        end = gregorian_expiration(now, GregorianDuration.WEEKS)
+        assert end == int(dt.datetime(2026, 1, 19, tzinfo=dt.timezone.utc)
+                          .timestamp() * 1000)
+
+    def test_token_bucket_gregorian_reset(self):
+        o = Oracle()
+        now = int(dt.datetime(2026, 1, 15, 10, 30, 59, 500_000,
+                              tzinfo=dt.timezone.utc).timestamp() * 1000)
+        b = Behavior.DURATION_IS_GREGORIAN
+        r = o.check(req(hits=5, duration=GregorianDuration.MINUTES, behavior=b), now)
+        assert r.remaining == 5
+        boundary = gregorian_expiration(now, GregorianDuration.MINUTES)
+        assert r.reset_time == boundary
+        # crossing the boundary resets every key
+        r = o.check(req(hits=1, duration=GregorianDuration.MINUTES, behavior=b),
+                    boundary + 1)
+        assert r.remaining == 9
+
+
+class TestLeakyBucket:
+    def lreq(self, **kw):
+        kw.setdefault("algorithm", Algorithm.LEAKY_BUCKET)
+        return req(**kw)
+
+    def test_fill_then_deny(self):
+        o = Oracle()
+        for i in range(10):
+            r = o.check(self.lreq(), NOW)
+            assert r.status == Status.UNDER_LIMIT, i
+            assert r.remaining == 9 - i
+        r = o.check(self.lreq(), NOW)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 0
+
+    def test_leak_replenishes_exactly(self):
+        # limit 10 per 60s → one token per 6000 ms
+        o = Oracle()
+        for _ in range(10):
+            o.check(self.lreq(), NOW)
+        r = o.check(self.lreq(hits=0), NOW + 5_999)
+        assert r.remaining == 0  # not yet a full token
+        r = o.check(self.lreq(), NOW + 6_000)  # exactly one token leaked
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 0
+
+    def test_replenish_caps_at_burst(self):
+        o = Oracle()
+        o.check(self.lreq(hits=5), NOW)
+        r = o.check(self.lreq(hits=0), NOW + 3_600_000)  # way past full
+        assert r.remaining == 10
+
+    def test_explicit_burst(self):
+        o = Oracle()
+        r = o.check(self.lreq(hits=15, burst=20), NOW)
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 5
+
+    def test_reset_time_is_one_token(self):
+        o = Oracle()
+        r = o.check(self.lreq(), NOW)
+        assert r.reset_time == NOW + 6_000
+
+    def test_sliding_expiry_forgets_idle_buckets(self):
+        o = Oracle()
+        for _ in range(10):
+            o.check(self.lreq(), NOW)
+        # idle for > duration → bucket forgotten, fresh burst available
+        r = o.check(self.lreq(), NOW + 60_001)
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 9
+
+    def test_duration_change_rescales(self):
+        o = Oracle()
+        o.check(self.lreq(hits=4), NOW)  # remaining 6
+        r = o.check(self.lreq(hits=0, duration=120_000), NOW)
+        assert r.remaining == 6  # whole tokens preserved
+
+    def test_drain_over_limit(self):
+        o = Oracle()
+        o.check(self.lreq(hits=8), NOW)
+        r = o.check(self.lreq(hits=5, behavior=Behavior.DRAIN_OVER_LIMIT), NOW)
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 0
+
+    def test_gregorian_flag_toggle_rescales_safely(self):
+        # regression: behavior flag toggles between ms and Gregorian
+        # interpretation of `duration` on the same key
+        o = Oracle()
+        o.check(self.lreq(hits=4, duration=60_000), NOW)  # remaining 6
+        b = Behavior.DURATION_IS_GREGORIAN
+        r = o.check(self.lreq(hits=0, duration=GregorianDuration.MINUTES,
+                              behavior=b), NOW)
+        assert r.remaining == 6  # whole tokens preserved, no crash
+        r = o.check(self.lreq(hits=0, duration=60_000), NOW)
+        assert r.remaining == 6  # and back
+
+    def test_algorithm_switch_resets(self):
+        o = Oracle()
+        o.check(req(hits=5), NOW)
+        r = o.check(self.lreq(hits=1), NOW + 1)
+        assert r.remaining == 9  # token item replaced by fresh leaky
+
+
+class TestHashing:
+    def test_hash_stable_and_nonzero(self):
+        from gubernator_tpu.hashing import hash_key, hash_keys
+        h1 = hash_key("test", "k")
+        assert h1 == hash_key("test", "k")
+        assert h1 != 0
+        import numpy as np
+        hs = hash_keys(["test_k", "a_b", "a_c"])
+        assert hs.dtype == np.uint64
+        assert hs[0] == np.uint64(h1)
+        assert len(set(hs.tolist())) == 3
+
+    def test_shard_scalar_matches_array(self):
+        # regression: scalar and vectorized shard_of must agree, including
+        # non-power-of-two shard counts
+        import numpy as np
+        from gubernator_tpu.hashing import hash_keys, shard_of
+        hs = hash_keys([f"k_{i}" for i in range(1000)])
+        for n in (1, 2, 3, 5, 7, 8):
+            arr = shard_of(hs, n)
+            assert all(shard_of(int(h), n) == arr[i] for i, h in enumerate(hs))
+            assert arr.min() >= 0 and arr.max() < n
+
+    def test_shard_distribution(self):
+        # hash_test.go analog: keys spread evenly across shards
+        import numpy as np
+        from gubernator_tpu.hashing import hash_keys, shard_of
+        keys = [f"tenant{i}_user{i * 7}" for i in range(20_000)]
+        shards = shard_of(hash_keys(keys), 8)
+        counts = np.bincount(shards, minlength=8)
+        assert counts.min() > 0.8 * counts.mean()
+        assert counts.max() < 1.2 * counts.mean()

@@ -1,0 +1,10 @@
+"""Single-chip device core: the HBM-resident counter table and the
+jit-compiled batch decision step (SURVEY.md §7.1 design stance).
+
+Replaces the reference's cache.go/lrucache.go (hashmap of CacheItems) and
+algorithms.go (per-key state transitions) with one struct-of-arrays
+resident in HBM and one gather→update→scatter program per request batch.
+"""
+from .table import TableState, init_table, occupancy, sweep_expired  # noqa: F401
+from .batch import RequestBatch, pack_requests, empty_batch  # noqa: F401
+from .step import decide_batch, StepOutput  # noqa: F401

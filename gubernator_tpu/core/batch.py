@@ -1,0 +1,102 @@
+"""Host-side request packing: wire requests → fixed-shape device arrays.
+
+The analog of the reference's request batching (peer_client.go › run()
+flush loop + gubernator.go › GetRateLimits fan-out): requests are
+coalesced into padded fixed-shape arrays so every batch reuses the same
+compiled program (SURVEY.md §7.3 — bucketed batch sizes avoid
+recompilation storms).
+
+Everything calendar- or string-shaped happens here, on the host: key
+hashing, Gregorian period-end computation, input clamps.  The device only
+ever sees integers.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence
+
+import jax
+import numpy as np
+
+from ..gregorian import gregorian_expiration, gregorian_rate_duration_ms
+from ..hashing import hash_keys
+from ..types import Algorithm, Behavior, GregorianDuration, RateLimitRequest
+
+#: Batch sizes are rounded up to one of these to bound compile cache size.
+BATCH_BUCKETS = (64, 256, 1024, 4096)
+
+
+class RequestBatch(NamedTuple):
+    """Fixed-shape [B] device view of a GetRateLimitsReq batch."""
+
+    key: jax.Array | np.ndarray  # uint64, 0 = padding
+    hits: jax.Array | np.ndarray  # int64, clamped ≥ 0
+    limit: jax.Array | np.ndarray  # int64, clamped ≥ 0
+    duration: jax.Array | np.ndarray  # int64, as given
+    eff_ms: jax.Array | np.ndarray  # int64, ≥ 1
+    greg_end: jax.Array | np.ndarray  # int64, calendar period end (0 if n/a)
+    behavior: jax.Array | np.ndarray  # int32 flags
+    algorithm: jax.Array | np.ndarray  # int32
+    burst: jax.Array | np.ndarray  # int64, already defaulted to limit
+    valid: jax.Array | np.ndarray  # bool
+
+
+def bucket_size(n: int) -> int:
+    for b in BATCH_BUCKETS:
+        if n <= b:
+            return b
+    return ((n + BATCH_BUCKETS[-1] - 1) // BATCH_BUCKETS[-1]) * BATCH_BUCKETS[-1]
+
+
+def empty_batch(size: int) -> RequestBatch:
+    return RequestBatch(
+        key=np.zeros(size, np.uint64),
+        hits=np.zeros(size, np.int64),
+        limit=np.zeros(size, np.int64),
+        duration=np.zeros(size, np.int64),
+        eff_ms=np.ones(size, np.int64),
+        greg_end=np.zeros(size, np.int64),
+        behavior=np.zeros(size, np.int32),
+        algorithm=np.zeros(size, np.int32),
+        burst=np.zeros(size, np.int64),
+        valid=np.zeros(size, bool),
+    )
+
+
+def pack_requests(
+    reqs: Sequence[RateLimitRequest],
+    now_ms: int,
+    size: int | None = None,
+) -> tuple[RequestBatch, List[str]]:
+    """Pack wire requests into a padded RequestBatch.
+
+    Returns (batch, errors) where errors[i] is a per-request error string
+    ("" if OK).  Requests with errors (e.g. invalid Gregorian ordinal —
+    the reference surfaces these as resp.Error) are marked invalid in the
+    batch and skipped by the device.
+    """
+    n = len(reqs)
+    b = empty_batch(size if size is not None else bucket_size(n))
+    errors = [""] * n
+    b.key[:n] = hash_keys([r.key for r in reqs])
+    for i, r in enumerate(reqs):
+        behavior = int(r.behavior)
+        duration = int(r.duration)
+        limit = max(int(r.limit), 0)
+        if behavior & Behavior.DURATION_IS_GREGORIAN:
+            try:
+                b.greg_end[i] = gregorian_expiration(now_ms, duration)
+                b.eff_ms[i] = gregorian_rate_duration_ms(duration)
+            except (ValueError, KeyError):
+                errors[i] = f"invalid gregorian duration ordinal: {duration}"
+                b.key[i] = 0
+                continue
+        else:
+            b.eff_ms[i] = max(duration, 1)
+        b.hits[i] = max(int(r.hits), 0)
+        b.limit[i] = limit
+        b.duration[i] = duration
+        b.behavior[i] = behavior
+        b.algorithm[i] = int(r.algorithm)
+        b.burst[i] = int(r.burst) if int(r.burst) > 0 else limit
+        b.valid[i] = True
+    return b, errors

@@ -1,0 +1,424 @@
+"""The batch decision step: one jit program per GetRateLimits batch.
+
+TPU-native replacement for the reference hot path (gubernator.go ›
+getLocalRateLimit → algorithms.go › tokenBucket/leakyBucket over an LRU
+map — reconstructed): hash-probe the key column → row indices (inserting
+misses), gather row state, apply both algorithms branchlessly, scatter
+back, return per-request (status, remaining, reset_time).
+
+Duplicate keys inside a batch must behave exactly as the reference's
+sequential per-request processing (SURVEY.md §7.3 "parity under
+batching").  Requests are sorted by row (stable, preserving request
+order); each segment (same key) is applied serially-equivalently:
+
+- position 0 of every segment runs the full per-request transition
+  vectorized across segments;
+- "simple" tails (uniform request fields, no RESET/DRAIN flags) have a
+  closed form: with per-request cost c and remaining r after position 0,
+  position j ≥ 1 is admitted iff j ≤ r // c;
+- everything else (mixed hits/configs/flags on one key) runs a
+  while_loop over in-segment positions, vectorized across segments —
+  bounded by the longest such segment, zero iterations when absent.
+
+All arithmetic is int64 (x64 enabled); semantics match oracle.py
+bit-for-bit — the parity tests enforce this on random + Zipf streams.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..types import Algorithm, Behavior
+from .batch import RequestBatch
+from .table import TableState
+
+PROBES = 8  # probe window per lookup
+INSERT_ROUNDS = 4  # slot-claim rounds per batch
+
+_RESET = int(Behavior.RESET_REMAINING)
+_DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
+_GREG = int(Behavior.DURATION_IS_GREGORIAN)
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+class StepOutput(NamedTuple):
+    """Per-request results in original request order."""
+
+    status: jax.Array  # int32[B], Status values
+    remaining: jax.Array  # int64[B]
+    reset_time: jax.Array  # int64[B]
+    limit: jax.Array  # int64[B]
+    err: jax.Array  # bool[B], True = table full / dropped
+    over_count: jax.Array  # int64, OVER_LIMIT decisions this batch
+    insert_count: jax.Array  # int64, new keys inserted
+
+
+class _Item(NamedTuple):
+    """Per-segment item state carried through in-segment positions."""
+
+    alg: jax.Array  # int32
+    status: jax.Array  # int32
+    limit: jax.Array
+    duration: jax.Array
+    eff: jax.Array
+    burst: jax.Array
+    rem: jax.Array
+    t: jax.Array
+    exp: jax.Array
+
+
+class _Req(NamedTuple):
+    """One request's fields, vectorized across segments."""
+
+    hits: jax.Array
+    limit: jax.Array
+    duration: jax.Array
+    eff: jax.Array
+    greg_end: jax.Array
+    behavior: jax.Array
+    alg: jax.Array
+    burst: jax.Array
+
+
+def _probe_slots(key: jax.Array, cap: int) -> jax.Array:
+    """[B, PROBES] int32 probe sequence (double hashing, odd stride)."""
+    stride = (key >> jnp.uint64(17)) | jnp.uint64(1)
+    p = jnp.arange(PROBES, dtype=jnp.uint64)
+    slots = (key[:, None] + p[None, :] * stride[:, None]) & jnp.uint64(cap - 1)
+    return slots.astype(jnp.int32)
+
+
+def _lookup(tkey: jax.Array, slots: jax.Array, key: jax.Array):
+    """(row int32[B] or -1, keys_at [B,P]) — first probe slot holding key."""
+    keys_at = tkey[slots]
+    match = keys_at == key[:, None]
+    found = match.any(axis=1)
+    fp = jnp.argmax(match, axis=1)
+    row = jnp.take_along_axis(slots, fp[:, None], axis=1)[:, 0]
+    return jnp.where(found, row, -1), keys_at
+
+
+def _insert(tkey: jax.Array, slots: jax.Array, key: jax.Array,
+            valid: jax.Array, row: jax.Array):
+    """Claim first-empty probe slots for missing keys, deterministically.
+
+    Per round: resolve matches (covers same-key losers of earlier
+    rounds), pick each active miss's first empty slot, dedupe claims by
+    slot (stable sort → lowest request index wins), scatter winners.
+    The analog of lrucache.go › Add, without locks: one batch is one
+    program, so claim conflicts are resolved by sort order, not mutexes.
+    """
+    cap = tkey.shape[0]
+    B = key.shape[0]
+    n_claimed = jnp.asarray(0, jnp.int64)
+
+    for _ in range(INSERT_ROUNDS):
+        keys_at = tkey[slots]
+        match = keys_at == key[:, None]
+        found = match.any(axis=1)
+        fp = jnp.argmax(match, axis=1)
+        frow = jnp.take_along_axis(slots, fp[:, None], axis=1)[:, 0]
+        row = jnp.where((row < 0) & valid & found, frow, row)
+
+        active = valid & (row < 0)
+        empty = keys_at == 0
+        has_empty = empty.any(axis=1)
+        ep = jnp.argmax(empty, axis=1)
+        cand = jnp.take_along_axis(slots, ep[:, None], axis=1)[:, 0]
+        cand_eff = jnp.where(active & has_empty, cand, cap)
+        order = jnp.argsort(cand_eff, stable=True)
+        c_s = cand_eff[order]
+        first = jnp.concatenate([jnp.ones(1, bool), c_s[1:] != c_s[:-1]])
+        first = first & (c_s < cap)
+        winner = jnp.zeros(B, bool).at[order].set(first)
+        tkey = tkey.at[jnp.where(winner, cand, cap)].set(key, mode="drop")
+        row = jnp.where(winner, cand, row)
+        n_claimed = n_claimed + winner.sum(dtype=jnp.int64)
+
+    # final resolve for same-key losers of the last round
+    keys_at = tkey[slots]
+    match = keys_at == key[:, None]
+    found = match.any(axis=1)
+    fp = jnp.argmax(match, axis=1)
+    frow = jnp.take_along_axis(slots, fp[:, None], axis=1)[:, 0]
+    row = jnp.where((row < 0) & valid & found, frow, row)
+    return tkey, row, n_claimed
+
+
+def _apply_position(item: _Item, req: _Req, now: jax.Array):
+    """One request applied to its item — the full §2.4 transition,
+    vectorized across segments.  Mirrors oracle.apply_token/apply_leaky
+    exactly (same operation order, same integer arithmetic)."""
+    i64 = jnp.int64
+    is_leaky = req.alg == int(Algorithm.LEAKY_BUCKET)
+    is_greg = (req.behavior & _GREG) != 0
+    reset = (req.behavior & _RESET) != 0
+    drain = (req.behavior & _DRAIN) != 0
+
+    # --- fresh determination (missing/expired/algorithm switch)
+    fresh = (now >= item.exp) | (item.alg != req.alg)
+    # token duration change → recompute expiry from created_at; expiring
+    # now means start fresh
+    tok_dur_change = (~is_leaky) & (~fresh) & (req.duration != item.duration)
+    new_exp_tok = jnp.where(is_greg, req.greg_end, item.t + req.eff)
+    exp1 = jnp.where(tok_dur_change, new_exp_tok, item.exp)
+    fresh = fresh | (tok_dur_change & (exp1 <= now))
+
+    # --- adopt fresh or existing state
+    tok_exp_fresh = jnp.where(is_greg, req.greg_end, now + req.eff)
+    rem_fresh = jnp.where(is_leaky, req.burst * req.eff, req.limit)
+    limit0 = jnp.where(fresh, req.limit, item.limit)
+    eff0 = jnp.where(fresh, req.eff, item.eff)
+    rem0 = jnp.where(fresh, rem_fresh, item.rem)
+    t0 = jnp.where(fresh, now, item.t)
+    exp0 = jnp.where(fresh, jnp.where(is_leaky, now + req.eff, tok_exp_fresh), exp1)
+    status0 = jnp.where(fresh, 0, item.status)
+
+    # --- leaky denominator change → rescale td fixed point
+    leaky_eff_change = is_leaky & (~fresh) & (req.eff != eff0)
+    whole = rem0 // jnp.maximum(eff0, 1)
+    frac = rem0 % jnp.maximum(eff0, 1)
+    rem_rescaled = whole * req.eff + (frac * req.eff) // jnp.maximum(eff0, 1)
+    rem0 = jnp.where(leaky_eff_change, rem_rescaled, rem0)
+    eff0 = jnp.where(is_leaky, req.eff, jnp.where(tok_dur_change, req.eff, eff0))
+
+    # --- RESET_REMAINING (existing items only; fresh items already start
+    # full — for leaky that means burst, not limit, as in the oracle)
+    reset_live = reset & (~fresh)
+    rem0 = jnp.where(reset_live,
+                     jnp.where(is_leaky, req.limit * req.eff, req.limit), rem0)
+    status0 = jnp.where(reset_live, 0, status0)
+    limit_after_reset = jnp.where(reset_live & (~is_leaky), req.limit, limit0)
+
+    # --- token limit change in place
+    tok_lim_change = (~is_leaky) & (req.limit != limit_after_reset)
+    rem_adj = jnp.clip(rem0 + req.limit - limit_after_reset, 0, req.limit)
+    rem0 = jnp.where(tok_lim_change, rem_adj, rem0)
+    limit1 = req.limit
+
+    # --- leaky replenish (exact: elapsed × limit td, clamped to burst)
+    burst1 = jnp.where(is_leaky, req.burst, limit1)
+    elapsed = now - t0
+    cap_td = burst1 * eff0
+    rem_rep = jnp.minimum(rem0 + elapsed * limit1, cap_td)
+    rem0 = jnp.where(is_leaky, rem_rep, rem0)
+    t1 = jnp.where(is_leaky, now, t0)
+
+    rate = jnp.where(limit1 > 0, eff0 // jnp.maximum(limit1, 1), eff0)
+    exp_out = jnp.where(is_leaky, now + eff0, exp0)
+    reset_time = jnp.where(is_leaky, now + rate, exp_out)
+
+    # --- hits
+    cost = jnp.where(is_leaky, req.hits * eff0, req.hits)
+    is_query = req.hits == 0
+    ok = cost <= rem0
+    rem2 = jnp.where((~is_query) & ok, rem0 - cost, rem0)
+    rem2 = jnp.where((~is_query) & (~ok) & drain, i64(0), rem2)
+    status1 = jnp.where(is_query, status0,
+                        jnp.where(ok, 0, 1)).astype(jnp.int32)
+
+    out_rem = jnp.where(is_leaky, rem2 // jnp.maximum(eff0, 1), rem2)
+    dur1 = req.duration
+    new_item = _Item(alg=req.alg, status=status1, limit=limit1, duration=dur1,
+                     eff=eff0, burst=burst1, rem=rem2, t=t1, exp=exp_out)
+    out = (status1, out_rem, reset_time, limit1)
+    return new_item, out
+
+
+def _tree_where(mask, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(mask, x, y), a, b)
+
+
+def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
+                      ) -> tuple[TableState, StepOutput]:
+    """Apply one request batch to the table; returns (new state, outputs).
+
+    Semantically equivalent to the reference's per-request loop in
+    gubernator.go › GetRateLimits over a local cache, for any batch
+    composition including duplicate keys.
+
+    Unjitted building block: compose under jit/scan/shard_map.  Use
+    ``decide_batch`` for direct host dispatch.
+    """
+    cap = state.key.shape[0]
+    B = batch.key.shape[0]
+    i32 = jnp.int32
+    i64 = jnp.int64
+    now = jnp.asarray(now_ms, i64)
+
+    key = batch.key
+    valid = batch.valid & (key != 0)
+
+    # ---- probe / insert -------------------------------------------------
+    slots = _probe_slots(key, cap)
+    tkey = state.key
+    row, _ = _lookup(tkey, slots, key)
+    row = jnp.where(valid & (row >= 0), row, -1)
+    miss = valid & (row < 0)
+
+    tkey, row, insert_count = lax.cond(
+        miss.any(),
+        lambda ops: _insert(*ops),
+        # zero derived from a varying operand so both branches have the
+        # same varying-manual-axes type under shard_map
+        lambda ops: (ops[0], ops[4], (ops[4].sum() * 0).astype(i64)),
+        (tkey, slots, key, valid, row),
+    )
+    err = valid & (row < 0)  # probe window exhausted: table overfull
+    row = jnp.where(valid & (row >= 0), row, cap)  # cap = dropped sentinel
+
+    # ---- sort into segments (stable keeps request order within key) ----
+    perm = jnp.argsort(row, stable=True)
+    r_s = row[perm]
+    head = jnp.concatenate([jnp.ones(1, bool), r_s[1:] != r_s[:-1]])
+    seg_id = (jnp.cumsum(head) - 1).astype(i32)
+    seg = partial(jax.ops.segment_min, segment_ids=seg_id, num_segments=B)
+    seg_max = partial(jax.ops.segment_max, segment_ids=seg_id, num_segments=B)
+    seg_start = seg(jnp.arange(B, dtype=i32))
+    seg_len = jax.ops.segment_sum(jnp.ones(B, i32), seg_id, num_segments=B)
+    seg_row = seg(r_s)
+    exists = (seg_len > 0) & (seg_row < cap)
+
+    sf = _Req(
+        hits=batch.hits[perm], limit=batch.limit[perm],
+        duration=batch.duration[perm], eff=batch.eff_ms[perm],
+        greg_end=batch.greg_end[perm], behavior=batch.behavior[perm],
+        alg=batch.algorithm[perm], burst=batch.burst[perm],
+    )
+
+    def uni(x):
+        return seg_max(x) == seg(x)
+
+    uniform = (uni(sf.hits) & uni(sf.limit) & uni(sf.duration) & uni(sf.eff)
+               & uni(sf.behavior) & uni(sf.alg) & uni(sf.burst))
+    any_flag = seg_max((sf.behavior & (_RESET | _DRAIN))) > 0
+    simple = exists & uniform & (~any_flag)
+    complex_seg = exists & (seg_len > 1) & (~simple)
+
+    # ---- gather item state per segment ---------------------------------
+    def grow(col, fill=0):
+        return col.at[seg_row].get(mode="fill", fill_value=fill)
+
+    item0 = _Item(
+        alg=(grow(state.meta) & 1).astype(i32),
+        status=((grow(state.meta) >> 1) & 1).astype(i32),
+        limit=grow(state.limit), duration=grow(state.duration),
+        eff=grow(state.eff_ms, 1), burst=grow(state.burst),
+        rem=grow(state.remaining), t=grow(state.t_ms),
+        exp=grow(state.expire_at),
+    )
+
+    idx0 = jnp.where(exists, seg_start, B).astype(i32)
+
+    def greq(x):
+        return x.at[idx0].get(mode="fill", fill_value=0)
+
+    req0 = _Req(*[greq(f) for f in sf])
+
+    item1, out0 = _apply_position(item0, req0, now)
+    item1 = _tree_where(exists, item1, item0)
+
+    # ---- simple tails: closed form, fully vectorized -------------------
+    is_leaky0 = req0.alg == int(Algorithm.LEAKY_BUCKET)
+    cost0 = jnp.where(is_leaky0, req0.hits * item1.eff, req0.hits)
+    k_raw = jnp.where(cost0 > 0, item1.rem // jnp.maximum(cost0, 1), _I64_MAX)
+    tail_n = jnp.maximum(seg_len - 1, 0).astype(i64)
+    k = jnp.minimum(k_raw, tail_n)  # accepted tail requests
+    # final per-segment state after the whole tail
+    s_rem_final = item1.rem - k * jnp.maximum(cost0, 0)
+    s_status_final = jnp.where(
+        cost0 > 0, jnp.where(tail_n <= k_raw, 0, 1), item1.status
+    ).astype(i32)
+    simple_tail_seg = simple & (seg_len > 1)
+    item_final = _Item(
+        alg=item1.alg,
+        status=jnp.where(simple_tail_seg, s_status_final, item1.status),
+        limit=item1.limit, duration=item1.duration, eff=item1.eff,
+        burst=item1.burst,
+        rem=jnp.where(simple_tail_seg, s_rem_final, item1.rem),
+        t=item1.t, exp=item1.exp,
+    )
+
+    # per-position outputs for simple tails
+    pos = jnp.arange(B, dtype=i32) - seg_start.at[seg_id].get(mode="fill", fill_value=0)
+    sid = seg_id
+    jj = pos.astype(i64)
+    tail_ok = jj <= k_raw[sid]
+    t_status = jnp.where(cost0[sid] > 0,
+                         jnp.where(tail_ok, 0, 1), item1.status[sid]).astype(i32)
+    t_rem = item1.rem[sid] - jnp.minimum(jj, k[sid]) * jnp.maximum(cost0[sid], 0)
+    t_rem_out = jnp.where(is_leaky0[sid],
+                          t_rem // jnp.maximum(item1.eff[sid], 1), t_rem)
+    tail_mask = simple[sid] & (pos > 0)
+
+    # assemble sorted-order outputs: heads then simple tails
+    o_status = jnp.zeros(B, i32).at[idx0].set(out0[0], mode="drop")
+    o_rem = jnp.zeros(B, i64).at[idx0].set(out0[1], mode="drop")
+    o_reset = jnp.zeros(B, i64).at[idx0].set(out0[2], mode="drop")
+    o_limit = jnp.zeros(B, i64).at[idx0].set(out0[3], mode="drop")
+    o_status = jnp.where(tail_mask, t_status, o_status)
+    o_rem = jnp.where(tail_mask, t_rem_out, o_rem)
+    o_reset = jnp.where(tail_mask, out0[2][sid], o_reset)
+    o_limit = jnp.where(tail_mask, out0[3][sid], o_limit)
+
+    # ---- complex tails: while_loop over in-segment positions -----------
+    max_complex = jnp.max(jnp.where(complex_seg, seg_len, 0))
+
+    def cond_fn(c):
+        return c[0] < max_complex
+
+    def body_fn(c):
+        j, item, (os_, or_, ot_, ol_) = c
+        idxj = jnp.where(complex_seg & (j < seg_len), seg_start + j, B).astype(i32)
+        reqj = _Req(*[x.at[idxj].get(mode="fill", fill_value=0) for x in sf])
+        m = complex_seg & (j < seg_len)
+        item2, outj = _apply_position(item, reqj, now)
+        item = _tree_where(m, item2, item)
+        os_ = os_.at[idxj].set(outj[0], mode="drop")
+        or_ = or_.at[idxj].set(outj[1], mode="drop")
+        ot_ = ot_.at[idxj].set(outj[2], mode="drop")
+        ol_ = ol_.at[idxj].set(outj[3], mode="drop")
+        return j + 1, item, (os_, or_, ot_, ol_)
+
+    _, item_final, (o_status, o_rem, o_reset, o_limit) = lax.while_loop(
+        cond_fn, body_fn,
+        (jnp.asarray(1, i32), item_final, (o_status, o_rem, o_reset, o_limit)),
+    )
+
+    # ---- write back per-segment final state ----------------------------
+    wrow = jnp.where(exists, seg_row, cap)
+    meta_new = (item_final.alg & 1) | ((item_final.status & 1) << 1)
+    new_state = TableState(
+        key=tkey,
+        meta=state.meta.at[wrow].set(meta_new.astype(i32), mode="drop"),
+        limit=state.limit.at[wrow].set(item_final.limit, mode="drop"),
+        duration=state.duration.at[wrow].set(item_final.duration, mode="drop"),
+        eff_ms=state.eff_ms.at[wrow].set(item_final.eff, mode="drop"),
+        burst=state.burst.at[wrow].set(item_final.burst, mode="drop"),
+        remaining=state.remaining.at[wrow].set(item_final.rem, mode="drop"),
+        t_ms=state.t_ms.at[wrow].set(item_final.t, mode="drop"),
+        expire_at=state.expire_at.at[wrow].set(item_final.exp, mode="drop"),
+    )
+
+    # ---- back to request order -----------------------------------------
+    inv = jnp.zeros(B, i32).at[perm].set(jnp.arange(B, dtype=i32))
+    status = jnp.where(valid & (~err), o_status[inv], 0)
+    remaining = jnp.where(valid & (~err), o_rem[inv], 0)
+    reset_time = jnp.where(valid & (~err), o_reset[inv], 0)
+    limit_out = jnp.where(valid & (~err), o_limit[inv], 0)
+    over_count = (valid & (~err) & (status == 1)).sum(dtype=i64)
+
+    return new_state, StepOutput(
+        status=status, remaining=remaining, reset_time=reset_time,
+        limit=limit_out, err=err, over_count=over_count,
+        insert_count=insert_count,
+    )
+
+
+#: Host-dispatch entry point; donates the table buffers (in-place update).
+decide_batch = jax.jit(decide_batch_impl, donate_argnums=(0,))

@@ -1,0 +1,92 @@
+"""The counter table: one struct-of-arrays resident in HBM.
+
+TPU-native replacement for the reference's Cache interface + LRUCache
+(cache.go › Cache{Add, GetItem, UpdateExpiration, Each, Remove},
+lrucache.go › LRUCache — reconstructed): instead of millions of heap
+items behind a map + intrusive list, all state lives in fixed-capacity
+parallel arrays; key→row is an open-addressing (double-hash probe) table
+over the ``key`` column.
+
+Eviction model (documented deviation, SURVEY.md §7.1): the reference
+evicts strict-LRU at capacity; here expired rows are reclaimed by
+``sweep_expired`` and capacity pressure is handled by sizing CAPACITY for
+the working set.  Decision parity is unaffected: an expired item and a
+missing item produce identical responses (both take the fresh-item path).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+# meta column bit layout
+META_ALG_MASK = 1  # bit0: Algorithm (0 token, 1 leaky)
+META_STATUS_SHIFT = 1  # bit1: stored Status (for hits=0 queries)
+
+
+class TableState(NamedTuple):
+    """Parallel [capacity] arrays; one row per tracked rate-limit key.
+
+    ``key`` is the 64-bit identity hash (0 = empty slot).  ``remaining``
+    holds tokens for TOKEN_BUCKET rows and token-duration fixed-point for
+    LEAKY_BUCKET rows (see oracle.py module docstring).  ``t_ms`` is
+    created_at for token rows, updated_at for leaky rows.
+    """
+
+    key: jax.Array  # uint64[cap], 0 = empty
+    meta: jax.Array  # int32[cap], bit0 alg, bit1 stored status
+    limit: jax.Array  # int64[cap]
+    duration: jax.Array  # int64[cap], as given (ms or Gregorian ordinal)
+    eff_ms: jax.Array  # int64[cap], effective ms denominator
+    burst: jax.Array  # int64[cap]
+    remaining: jax.Array  # int64[cap]
+    t_ms: jax.Array  # int64[cap]
+    expire_at: jax.Array  # int64[cap], 0 = never-written (always expired)
+
+    @property
+    def capacity(self) -> int:
+        return self.key.shape[0]
+
+
+def init_table(capacity: int) -> TableState:
+    """Empty table.  ``capacity`` must be a power of two (probe masking)."""
+    if capacity & (capacity - 1) or capacity <= 0:
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    if not jax.config.jax_enable_x64:
+        # Guard against an embedding application resetting the flag after
+        # our import-time enable: int64 columns would silently become
+        # int32 and overflow on epoch-ms arithmetic.
+        raise RuntimeError(
+            "gubernator_tpu requires jax_enable_x64 (int64 epoch-ms "
+            "arithmetic); it was disabled after import")
+    return TableState(
+        key=jnp.zeros((capacity,), jnp.uint64),
+        meta=jnp.zeros((capacity,), jnp.int32),
+        limit=jnp.zeros((capacity,), jnp.int64),
+        duration=jnp.zeros((capacity,), jnp.int64),
+        eff_ms=jnp.ones((capacity,), jnp.int64),
+        burst=jnp.zeros((capacity,), jnp.int64),
+        remaining=jnp.zeros((capacity,), jnp.int64),
+        t_ms=jnp.zeros((capacity,), jnp.int64),
+        expire_at=jnp.zeros((capacity,), jnp.int64),
+    )
+
+
+def occupancy(state: TableState) -> jax.Array:
+    """Number of live rows (cache-size gauge analog, lrucache.go)."""
+    return (state.key != 0).sum()
+
+
+@jax.jit
+def sweep_expired(state: TableState, now_ms: jax.Array) -> TableState:
+    """Reclaim rows whose expiry has passed.
+
+    Parity-safe: an expired row and an empty row behave identically on
+    next access (fresh-item path), so clearing keys changes no decisions.
+    Replaces the reference's LRU eviction + UpdateExpiration bookkeeping.
+    """
+    dead = state.expire_at <= now_ms
+    return state._replace(key=jnp.where(dead, jnp.uint64(0), state.key))

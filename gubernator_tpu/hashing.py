@@ -34,21 +34,45 @@ def fnv1a64(data: bytes) -> int:
     return h
 
 
+_M = (1 << 64) - 1
+
+
+def mix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64-style avalanche finalizer (uint64 → uint64).
+
+    FNV-1a's high bits cluster for short similar keys, which would skew
+    hash-range shard ownership and probe strides; this spreads entropy
+    across all 64 bits.  Single source of truth for the finalizer — the
+    scalar path and bench stream generation both use it.  The optional C
+    extension returns RAW FNV-1a (no mix, no zero-remap); the finalizer
+    is always applied here.
+    """
+    x = x.astype(np.uint64).copy()
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
 def hash_key(name: str, unique_key: str) -> int:
     """64-bit identity hash of a rate limit, never 0."""
-    h = fnv1a64((name + "_" + unique_key).encode("utf-8"))
+    h = int(mix64_np(np.array([fnv1a64((name + "_" + unique_key).encode("utf-8"))],
+                              dtype=np.uint64))[0])
     return h if h != 0 else 1
 
 
 def hash_keys(keys: Sequence[str]) -> np.ndarray:
     """Batch hash → uint64[len(keys)], never 0."""
     if _native is not None:
-        return _native.hash_keys(keys)
-    out = np.empty(len(keys), dtype=np.uint64)
-    for i, k in enumerate(keys):
-        h = fnv1a64(k.encode("utf-8"))
-        out[i] = h if h != 0 else 1
-    return out
+        raw = _native.hash_keys(keys)  # raw FNV-1a, finalizer applied below
+    else:
+        raw = np.empty(len(keys), dtype=np.uint64)
+        for i, k in enumerate(keys):
+            raw[i] = fnv1a64(k.encode("utf-8"))
+    x = mix64_np(raw)
+    return np.where(x == 0, np.uint64(1), x)
 
 
 def shard_of(key_hash: np.ndarray | int, num_shards: int) -> np.ndarray | int:

@@ -1,0 +1,47 @@
+"""Mesh construction and table shardings.
+
+The key universe is ranged-sharded across the ``shard`` mesh axis by the
+top bits of the key hash (hashing.shard_of) — the TPU-native equivalent
+of the reference's consistent-hash key ownership (hash.go ›
+ConsistantHash / replicated_hash.go — reconstructed).  Each device owns
+one contiguous hash range; its table shard lives in its HBM.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.table import TableState, init_table
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None,
+              n: int | None = None) -> Mesh:
+    """1-D mesh over ``n`` devices (default: all local devices)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded across the mesh: row block d of the global table is
+    device d's hash range."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def shard_table(mesh: Mesh, capacity_per_shard: int) -> TableState:
+    """Build a global table of n_shards × capacity_per_shard rows,
+    sharded one block per device."""
+    n = mesh.shape[SHARD_AXIS]
+    global_tab = init_table_global(n * capacity_per_shard)
+    sh = table_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), global_tab)
+
+
+def init_table_global(total_capacity: int) -> TableState:
+    return init_table(total_capacity)

@@ -1,12 +1,25 @@
 """Test config: force an 8-device CPU platform (the reference's
-cluster/cluster.go in-process multi-daemon analog, SURVEY.md §4) and
-enable x64 before jax initializes."""
+cluster/cluster.go in-process multi-daemon analog, SURVEY.md §4).
+
+The sandbox's sitecustomize registers the axon TPU plugin at interpreter
+start and overwrites the jax_platforms CONFIG (not just the env var) to
+"axon,cpu" — so tests must override via jax.config.update, before any
+backend initialization.  Env vars alone do not work here.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+# Persistent compile cache: the step program is large; don't re-pay XLA
+# compilation on every pytest invocation.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gubernator_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

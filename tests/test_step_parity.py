@@ -1,0 +1,230 @@
+"""M1 parity harness: device decide_batch vs the M0 oracle, bit-for-bit.
+
+The north-star requires allow/deny parity with the reference semantics
+(BASELINE.md); the oracle is the executable form of that contract, so
+every stream here asserts exact equality of (status, remaining,
+reset_time, limit) for every request.
+"""
+import numpy as np
+import pytest
+
+from gubernator_tpu import Algorithm, Behavior, GregorianDuration, Oracle, RateLimitRequest
+from gubernator_tpu.core import decide_batch, init_table, pack_requests
+
+NOW = 1_760_000_000_000
+CAP = 1 << 14
+
+
+def run_stream(batches, cap=CAP):
+    """batches: list of (reqs, now_ms). Returns list of mismatches."""
+    oracle = Oracle()
+    state = init_table(cap)
+    mismatches = []
+    for bi, (reqs, now) in enumerate(batches):
+        want = oracle.check_batch(reqs, now)
+        packed, errs = pack_requests(reqs, now)
+        state, out = decide_batch(state, packed, now)
+        status = np.asarray(out.status)
+        rem = np.asarray(out.remaining)
+        rst = np.asarray(out.reset_time)
+        lim = np.asarray(out.limit)
+        err = np.asarray(out.err)
+        for i, w in enumerate(want):
+            if errs[i]:
+                continue  # host-side rejected (e.g. bad gregorian ordinal)
+            if err[i]:
+                mismatches.append((bi, i, "table-full", None, None))
+                continue
+            got = (int(status[i]), int(rem[i]), int(rst[i]), int(lim[i]))
+            exp = (int(w.status), int(w.remaining), int(w.reset_time), int(w.limit))
+            if got != exp:
+                mismatches.append((bi, i, reqs[i], exp, got))
+    return mismatches
+
+
+def assert_parity(batches, cap=CAP):
+    mm = run_stream(batches, cap)
+    assert not mm, f"{len(mm)} mismatches; first 5: {mm[:5]}"
+
+
+def mk(name="t", key="k", **kw):
+    d = dict(hits=1, limit=10, duration=60_000, algorithm=Algorithm.TOKEN_BUCKET)
+    d.update(kw)
+    return RateLimitRequest(name=name, unique_key=key, **d)
+
+
+class TestBasicParity:
+    def test_single_key_token(self):
+        batches = [([mk()] , NOW + i * 100) for i in range(15)]
+        assert_parity(batches)
+
+    def test_single_key_leaky(self):
+        batches = [([mk(algorithm=Algorithm.LEAKY_BUCKET)], NOW + i * 700)
+                   for i in range(30)]
+        assert_parity(batches)
+
+    def test_many_unique_keys(self):
+        batches = []
+        for t in range(5):
+            reqs = [mk(key=f"k{i}", hits=1 + i % 3, limit=5 + i % 7)
+                    for i in range(100)]
+            batches.append((reqs, NOW + t * 1000))
+        assert_parity(batches)
+
+    def test_expiry_across_batches(self):
+        batches = [
+            ([mk(hits=10)], NOW),
+            ([mk(hits=1)], NOW + 59_999),   # still over
+            ([mk(hits=1)], NOW + 60_000),   # reset
+            ([mk(hits=1)], NOW + 200_000),  # reset again
+        ]
+        assert_parity(batches)
+
+    def test_hits_zero_queries(self):
+        batches = [
+            ([mk(hits=3)], NOW),
+            ([mk(hits=0)], NOW + 1),
+            ([mk(hits=100)], NOW + 2),
+            ([mk(hits=0)], NOW + 3),  # stored OVER status
+        ]
+        assert_parity(batches)
+
+
+class TestDuplicateKeyParity:
+    def test_uniform_duplicates_closed_form(self):
+        # 7 identical requests for one key in one batch: 5 admitted
+        batches = [([mk(limit=5) for _ in range(7)], NOW)]
+        assert_parity(batches)
+
+    def test_uniform_duplicates_multi_hit(self):
+        batches = [([mk(hits=3, limit=10) for _ in range(5)], NOW)]
+        assert_parity(batches)
+
+    def test_mixed_hits_loop_path(self):
+        # remaining=5: [3,4,2] → ok, over, ok — the sequential trap
+        batches = [
+            ([mk(hits=5, limit=10)], NOW),
+            ([mk(hits=3), mk(hits=4), mk(hits=2)], NOW + 1),
+        ]
+        assert_parity(batches)
+
+    def test_mixed_flags_loop_path(self):
+        reqs = [
+            mk(hits=8),
+            mk(hits=5),  # over
+            mk(hits=1, behavior=Behavior.RESET_REMAINING),
+            mk(hits=4, behavior=Behavior.DRAIN_OVER_LIMIT | Behavior.BATCHING),
+            mk(hits=20, behavior=Behavior.DRAIN_OVER_LIMIT),  # over → drain
+            mk(hits=0),
+        ]
+        assert_parity([(reqs, NOW)])
+
+    def test_duplicates_among_many_keys(self):
+        rng = np.random.default_rng(0)
+        batches = []
+        for t in range(4):
+            reqs = []
+            for _ in range(200):
+                k = f"k{rng.integers(0, 30)}"
+                reqs.append(mk(key=k, hits=int(rng.integers(0, 4)), limit=20))
+            batches.append((reqs, NOW + t * 5_000))
+        assert_parity(batches)
+
+    def test_config_change_within_batch(self):
+        batches = [(
+            [mk(hits=1, limit=100), mk(hits=1, limit=50), mk(hits=1, limit=200)],
+            NOW,
+        )]
+        assert_parity(batches)
+
+    def test_new_key_duplicates_in_one_batch(self):
+        # both duplicates miss, must resolve to the SAME row
+        batches = [([mk(key="brand-new", limit=3) for _ in range(5)], NOW)]
+        assert_parity(batches)
+
+
+class TestBehaviorParity:
+    def test_reset_remaining(self):
+        batches = [
+            ([mk(hits=10)], NOW),
+            ([mk(hits=2, behavior=Behavior.RESET_REMAINING)], NOW + 1),
+        ]
+        assert_parity(batches)
+
+    def test_drain_over_limit(self):
+        batches = [
+            ([mk(hits=7)], NOW),
+            ([mk(hits=5, behavior=Behavior.DRAIN_OVER_LIMIT)], NOW + 1),
+        ]
+        assert_parity(batches)
+
+    def test_gregorian_token(self):
+        b = Behavior.DURATION_IS_GREGORIAN
+        batches = [
+            ([mk(hits=2, duration=GregorianDuration.MINUTES, behavior=b)], NOW),
+            ([mk(hits=2, duration=GregorianDuration.MINUTES, behavior=b)], NOW + 30_000),
+            ([mk(hits=2, duration=GregorianDuration.MINUTES, behavior=b)], NOW + 70_000),
+        ]
+        assert_parity(batches)
+
+    def test_invalid_gregorian_is_host_error(self):
+        reqs = [mk(duration=99, behavior=Behavior.DURATION_IS_GREGORIAN), mk(key="ok")]
+        packed, errs = pack_requests(reqs, NOW)
+        assert "invalid gregorian" in errs[0]
+        assert errs[1] == ""
+        assert not packed.valid[0] and packed.valid[1]
+
+    def test_leaky_burst_and_duration_change(self):
+        L = Algorithm.LEAKY_BUCKET
+        batches = [
+            ([mk(algorithm=L, hits=4, burst=20)], NOW),
+            ([mk(algorithm=L, hits=0, duration=120_000, burst=20)], NOW + 500),
+            ([mk(algorithm=L, hits=3, duration=120_000, burst=20)], NOW + 1_000),
+        ]
+        assert_parity(batches)
+
+    def test_algorithm_switch(self):
+        batches = [
+            ([mk(hits=5)], NOW),
+            ([mk(hits=1, algorithm=Algorithm.LEAKY_BUCKET)], NOW + 1),
+            ([mk(hits=1)], NOW + 2),
+        ]
+        assert_parity(batches)
+
+
+class TestRandomizedParity:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_random_stream(self, seed):
+        rng = np.random.default_rng(seed)
+        algs = [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+        behs = [Behavior.BATCHING, Behavior.RESET_REMAINING,
+                Behavior.DRAIN_OVER_LIMIT]
+        batches = []
+        now = NOW
+        for _ in range(6):
+            reqs = []
+            for _ in range(int(rng.integers(1, 120))):
+                reqs.append(RateLimitRequest(
+                    name=f"n{rng.integers(0, 3)}",
+                    unique_key=f"u{rng.integers(0, 40)}",
+                    hits=int(rng.integers(0, 6)),
+                    limit=int(rng.integers(1, 30)),
+                    duration=int(rng.choice([1_000, 10_000, 60_000])),
+                    algorithm=algs[int(rng.integers(0, 2))],
+                    behavior=behs[int(rng.integers(0, 3))],
+                    burst=int(rng.choice([0, 0, 15])),
+                ))
+            batches.append((reqs, now))
+            now += int(rng.integers(0, 20_000))
+        assert_parity(batches)
+
+    def test_zipf_stream(self):
+        rng = np.random.default_rng(7)
+        batches = []
+        now = NOW
+        for _ in range(5):
+            ks = rng.zipf(1.5, size=256) % 500
+            reqs = [mk(key=f"z{k}", limit=50) for k in ks]
+            batches.append((reqs, now))
+            now += 3_000
+        assert_parity(batches)

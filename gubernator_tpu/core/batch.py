@@ -66,6 +66,7 @@ def pack_requests(
     reqs: Sequence[RateLimitRequest],
     now_ms: int,
     size: int | None = None,
+    key_hashes: np.ndarray | None = None,
 ) -> tuple[RequestBatch, List[str]]:
     """Pack wire requests into a padded RequestBatch.
 
@@ -73,11 +74,16 @@ def pack_requests(
     ("" if OK).  Requests with errors (e.g. invalid Gregorian ordinal —
     the reference surfaces these as resp.Error) are marked invalid in the
     batch and skipped by the device.
+
+    ``key_hashes`` lets a dispatcher that already hashed the keys (for
+    shard routing) skip re-hashing — string hashing is the host-side
+    bottleneck.
     """
     n = len(reqs)
     b = empty_batch(size if size is not None else bucket_size(n))
     errors = [""] * n
-    b.key[:n] = hash_keys([r.key for r in reqs])
+    b.key[:n] = key_hashes if key_hashes is not None else hash_keys(
+        [r.key for r in reqs])
     for i, r in enumerate(reqs):
         behavior = int(r.behavior)
         duration = int(r.duration)

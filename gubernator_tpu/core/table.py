@@ -89,4 +89,10 @@ def sweep_expired(state: TableState, now_ms: jax.Array) -> TableState:
     Replaces the reference's LRU eviction + UpdateExpiration bookkeeping.
     """
     dead = state.expire_at <= now_ms
-    return state._replace(key=jnp.where(dead, jnp.uint64(0), state.key))
+    return state._replace(
+        key=jnp.where(dead, jnp.uint64(0), state.key),
+        # Also zero expire_at so a later occupant of the slot is
+        # unconditionally fresh even if its first access carries an
+        # earlier now_ms (caller clock skew) than the dead row's expiry.
+        expire_at=jnp.where(dead, jnp.int64(0), state.expire_at),
+    )

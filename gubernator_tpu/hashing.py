@@ -34,9 +34,6 @@ def fnv1a64(data: bytes) -> int:
     return h
 
 
-_M = (1 << 64) - 1
-
-
 def mix64_np(x: np.ndarray) -> np.ndarray:
     """Vectorized splitmix64-style avalanche finalizer (uint64 → uint64).
 
@@ -47,7 +44,7 @@ def mix64_np(x: np.ndarray) -> np.ndarray:
     extension returns RAW FNV-1a (no mix, no zero-remap); the finalizer
     is always applied here.
     """
-    x = x.astype(np.uint64).copy()
+    x = x.astype(np.uint64)  # astype copies; in-place ops below are safe
     x ^= x >> np.uint64(30)
     x *= np.uint64(0xBF58476D1CE4E5B9)
     x ^= x >> np.uint64(27)

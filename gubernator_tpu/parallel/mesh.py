@@ -38,10 +38,6 @@ def shard_table(mesh: Mesh, capacity_per_shard: int) -> TableState:
     """Build a global table of n_shards × capacity_per_shard rows,
     sharded one block per device."""
     n = mesh.shape[SHARD_AXIS]
-    global_tab = init_table_global(n * capacity_per_shard)
+    global_tab = init_table(n * capacity_per_shard)
     sh = table_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sh), global_tab)
-
-
-def init_table_global(total_capacity: int) -> TableState:
-    return init_table(total_capacity)

@@ -50,7 +50,10 @@ def make_sharded_step(mesh):
         in_specs=(P(S), P(S), P()),
         out_specs=(P(S), P(S), P()),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    # No donation: aliased table buffers force serial in-place scatters on
+    # TPU; unaliased, the scatters fuse into a dense streaming copy (see
+    # core/step.py › decide_batch).
+    return jax.jit(sharded)
 
 
 class ShardedEngine:
@@ -93,7 +96,8 @@ class ShardedEngine:
         from ..hashing import hash_keys
 
         n = len(reqs)
-        shard = shard_of(hash_keys([r.key for r in reqs]), self.n)
+        khash = hash_keys([r.key for r in reqs])
+        shard = shard_of(khash, self.n)
         responses: List[RateLimitResponse] = [None] * n  # type: ignore
         pending = list(range(n))
         retried: set = set()
@@ -121,7 +125,8 @@ class ShardedEngine:
                 if not idxs:
                     continue
                 packed, errs = pack_requests([reqs[i] for i in idxs], now_ms,
-                                             size=len(idxs))
+                                             size=len(idxs),
+                                             key_hashes=khash[idxs])
                 base = s * self.B
                 for f in range(len(glob)):
                     np.asarray(glob[f])[base:base + len(idxs)] = packed[f]
@@ -161,5 +166,8 @@ class ShardedEngine:
                         remaining=int(rem[slot]),
                         reset_time=int(rst[slot]),
                     )
-            pending = rest
+            # Restore request-index order: overflow + retried indices were
+            # appended out of order, and same-key requests must be applied
+            # in original order for sequential parity.
+            pending = sorted(rest)
         return responses

@@ -1,0 +1,324 @@
+"""Layered configuration: programmatic dataclasses + GUBER_* env vars +
+key=value config file.
+
+The analog of the reference's config surface (config.go › Config /
+BehaviorConfig / DaemonConfig / SetupDaemonConfig / SetDefaults —
+reconstructed, mount empty): same knob names, same layering (defaults <
+config file < environment), Go-style duration strings ("500ms", "30s")
+accepted everywhere a duration appears.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu")
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DUR_UNIT_MS = {"ns": 1e-6, "us": 1e-3, "µs": 1e-3, "ms": 1.0,
+                "s": 1000.0, "m": 60_000.0, "h": 3_600_000.0}
+
+
+def parse_duration_ms(s: str | int | float) -> int:
+    """Go-style duration string → integer milliseconds.
+
+    Accepts bare numbers (already ms) and compound strings ("1m30s").
+    Mirrors the reference's use of time.ParseDuration in config loading.
+    """
+    if isinstance(s, (int, float)):
+        return int(s)
+    s = s.strip()
+    if not s:
+        return 0
+    if re.fullmatch(r"-?\d+", s):
+        return int(s)
+    total = 0.0
+    pos = 0
+    neg = s.startswith("-")
+    if neg:
+        pos = 1
+    for m in _DUR_RE.finditer(s, pos):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration: {s!r}")
+        total += float(m.group(1)) * _DUR_UNIT_MS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration: {s!r}")
+    return int(-total if neg else total)
+
+
+@dataclass
+class BehaviorConfig:
+    """Batch/global/multi-region timing knobs.
+
+    reference: config.go › BehaviorConfig (same field names, ms integers
+    instead of time.Duration).
+    """
+
+    #: How long to wait for more requests before flushing a peer batch.
+    batch_timeout_ms: int = 500
+    #: Time the owner waits to accumulate forwarded batches.
+    batch_wait_ms: int = 500
+    #: Max requests in one forwarded peer batch (reference default 1000).
+    batch_limit: int = 1000
+
+    #: How long to accumulate GLOBAL hit deltas before syncing to owner.
+    global_sync_wait_ms: int = 100
+    #: Deadline for global sync RPCs.
+    global_timeout_ms: int = 500
+    #: Max global hits per sync batch.
+    global_batch_limit: int = 1000
+    #: Interval between owner broadcasts of updated GLOBAL state.
+    global_broadcast_interval_ms: int = 100
+
+    #: Multi-region analogs (SURVEY.md §2.1 mutliregion.go).
+    multi_region_sync_wait_ms: int = 300
+    multi_region_timeout_ms: int = 900
+    multi_region_batch_limit: int = 1000
+
+
+@dataclass
+class Config:
+    """Core-instance configuration.
+
+    reference: config.go › Config (fields the TPU design keeps; cache
+    workers/locks are replaced by the device table, SURVEY.md §7.1).
+    """
+
+    #: Rows in the device counter table (power of two).  The analog of
+    #: the reference's CacheSize (default 50 000 → rounded up to 2^16).
+    cache_size: int = 1 << 16
+    #: Device batch rows per shard per step.
+    batch_rows: int = 1024
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    #: This node's datacenter name (multi-region routing).
+    data_center: str = ""
+    #: Optional persistence hooks (store.py); any object implementing
+    #: the Loader / Store protocols.
+    loader: Optional[object] = None
+    store: Optional[object] = None
+    #: Seconds between expired-row sweeps (0 disables).
+    sweep_interval_ms: int = 30_000
+    #: Local peer identity (set by the daemon).
+    advertise_address: str = ""
+
+    def set_defaults(self) -> "Config":
+        """Normalize invalid values, like config.go › SetDefaults."""
+        if self.cache_size <= 0:
+            self.cache_size = 1 << 16
+        # round up to a power of two (device probe masking requires it)
+        self.cache_size = 1 << (self.cache_size - 1).bit_length()
+        if self.batch_rows <= 0:
+            self.batch_rows = 1024
+        return self
+
+
+@dataclass
+class TLSSettings:
+    """reference: tls.go › TLSConfig (declarative part)."""
+
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    #: Generate a self-signed server certificate in memory.
+    auto_tls: bool = False
+    #: "none" | "request" | "require-any" | "verify" (client certs).
+    client_auth: str = "none"
+    client_auth_ca_file: str = ""
+    insecure_skip_verify: bool = False
+
+
+@dataclass
+class DaemonConfig:
+    """Everything needed to spawn a daemon.
+
+    reference: config.go › DaemonConfig + SetupDaemonConfig env names
+    (GUBER_* — reconstructed).
+    """
+
+    grpc_listen_address: str = "localhost:1051"
+    http_listen_address: str = "localhost:1050"
+    advertise_address: str = ""
+    cache_size: int = 1 << 16
+    data_center: str = ""
+    instance_id: str = ""
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    tls: Optional[TLSSettings] = None
+    log_level: str = "info"
+
+    #: "none" | "static" | "file" | "dns" | "etcd" | "k8s" | "member-list"
+    peer_discovery_type: str = "none"
+    #: static discovery: explicit peer list.
+    static_peers: List[str] = field(default_factory=list)
+    #: file discovery: path to a JSON/lines peers file, re-read on change.
+    peers_file: str = ""
+    #: dns discovery.
+    dns_fqdn: str = ""
+    dns_resolve_interval_ms: int = 30_000
+    #: etcd / k8s / member-list endpoints (gated: stub unless client
+    #: libraries are installed — SURVEY.md §2.1 discovery rows).
+    etcd_endpoints: List[str] = field(default_factory=list)
+    etcd_prefix: str = "/gubernator/peers/"
+    k8s_namespace: str = ""
+    k8s_pod_selector: str = ""
+    memberlist_known_hosts: List[str] = field(default_factory=list)
+
+    #: Path for Loader snapshots ("" disables checkpoint/resume).
+    snapshot_path: str = ""
+
+    def instance_config(self) -> Config:
+        return Config(
+            cache_size=self.cache_size,
+            behaviors=self.behaviors,
+            data_center=self.data_center,
+            advertise_address=self.advertise_address or self.grpc_listen_address,
+        ).set_defaults()
+
+
+_MISSING = object()
+
+
+class _Src:
+    """One layered config source: conf-file dict then environment."""
+
+    def __init__(self, conf: Dict[str, str]):
+        self.conf = conf
+
+    def get(self, name: str, default=_MISSING, cast: Callable = str):
+        v = os.environ.get(name, _MISSING)
+        if v is _MISSING:
+            v = self.conf.get(name, _MISSING)
+        if v is _MISSING:
+            if default is _MISSING:
+                return None
+            return default
+        if cast is bool:
+            return str(v).strip().lower() in ("1", "true", "yes", "on")
+        return cast(v)
+
+
+def load_conf_file(path: str) -> Dict[str, str]:
+    """Parse a `KEY=value` config file (reference example.conf format):
+    blank lines and #-comments ignored."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"invalid config line (want KEY=value): {line!r}")
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def setup_daemon_config(conf_file: str = "",
+                        env: Optional[Dict[str, str]] = None) -> DaemonConfig:
+    """Build a DaemonConfig from defaults < config file < environment.
+
+    reference: config.go › SetupDaemonConfig.  ``env`` overrides
+    os.environ for tests.
+    """
+    conf = load_conf_file(conf_file) if conf_file else {}
+    if env is not None:
+        conf = {**conf, **env}
+        src = _Src(conf)
+        # env-dict mode: don't consult os.environ (hermetic tests)
+        src.get = lambda name, default=_MISSING, cast=str: (  # type: ignore
+            (default if default is not _MISSING else None)
+            if conf.get(name, _MISSING) is _MISSING
+            else (str(conf[name]).strip().lower() in ("1", "true", "yes", "on")
+                  if cast is bool else cast(conf[name])))
+    else:
+        src = _Src(conf)
+
+    d = DaemonConfig()
+    d.grpc_listen_address = src.get("GUBER_GRPC_ADDRESS", d.grpc_listen_address)
+    d.http_listen_address = src.get("GUBER_HTTP_ADDRESS", d.http_listen_address)
+    d.advertise_address = src.get("GUBER_ADVERTISE_ADDRESS", d.advertise_address)
+    d.cache_size = src.get("GUBER_CACHE_SIZE", d.cache_size, int)
+    d.data_center = src.get("GUBER_DATA_CENTER", d.data_center)
+    d.instance_id = src.get("GUBER_INSTANCE_ID", d.instance_id)
+    d.log_level = src.get("GUBER_LOG_LEVEL", d.log_level)
+    d.snapshot_path = src.get("GUBER_SNAPSHOT_PATH", d.snapshot_path)
+
+    b = d.behaviors
+    b.batch_timeout_ms = src.get("GUBER_BATCH_TIMEOUT", b.batch_timeout_ms,
+                                 parse_duration_ms)
+    b.batch_wait_ms = src.get("GUBER_BATCH_WAIT", b.batch_wait_ms,
+                              parse_duration_ms)
+    b.batch_limit = src.get("GUBER_BATCH_LIMIT", b.batch_limit, int)
+    b.global_sync_wait_ms = src.get("GUBER_GLOBAL_SYNC_WAIT",
+                                    b.global_sync_wait_ms, parse_duration_ms)
+    b.global_timeout_ms = src.get("GUBER_GLOBAL_TIMEOUT", b.global_timeout_ms,
+                                  parse_duration_ms)
+    b.global_batch_limit = src.get("GUBER_GLOBAL_BATCH_LIMIT",
+                                   b.global_batch_limit, int)
+    b.global_broadcast_interval_ms = src.get(
+        "GUBER_GLOBAL_BROADCAST_INTERVAL", b.global_broadcast_interval_ms,
+        parse_duration_ms)
+    b.multi_region_sync_wait_ms = src.get(
+        "GUBER_MULTI_REGION_SYNC_WAIT", b.multi_region_sync_wait_ms,
+        parse_duration_ms)
+    b.multi_region_timeout_ms = src.get(
+        "GUBER_MULTI_REGION_TIMEOUT", b.multi_region_timeout_ms,
+        parse_duration_ms)
+    b.multi_region_batch_limit = src.get(
+        "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit, int)
+
+    d.peer_discovery_type = src.get("GUBER_PEER_DISCOVERY_TYPE",
+                                    d.peer_discovery_type)
+    peers = src.get("GUBER_PEERS", "")
+    if peers:
+        d.static_peers = [p.strip() for p in peers.split(",") if p.strip()]
+        if d.peer_discovery_type == "none":
+            d.peer_discovery_type = "static"
+    d.peers_file = src.get("GUBER_PEERS_FILE", d.peers_file)
+    d.dns_fqdn = src.get("GUBER_DNS_FQDN", d.dns_fqdn)
+    d.dns_resolve_interval_ms = src.get("GUBER_DNS_RESOLVE_INTERVAL",
+                                        d.dns_resolve_interval_ms,
+                                        parse_duration_ms)
+    etcd = src.get("GUBER_ETCD_ENDPOINTS", "")
+    if etcd:
+        d.etcd_endpoints = [p.strip() for p in etcd.split(",") if p.strip()]
+    d.etcd_prefix = src.get("GUBER_ETCD_PREFIX", d.etcd_prefix)
+    d.k8s_namespace = src.get("GUBER_K8S_NAMESPACE", d.k8s_namespace)
+    d.k8s_pod_selector = src.get("GUBER_K8S_POD_SELECTOR", d.k8s_pod_selector)
+    ml = src.get("GUBER_MEMBERLIST_KNOWN_HOSTS", "")
+    if ml:
+        d.memberlist_known_hosts = [p.strip() for p in ml.split(",") if p.strip()]
+
+    if (src.get("GUBER_TLS_AUTO", False, bool)
+            or src.get("GUBER_TLS_CERT", "") or src.get("GUBER_TLS_CA", "")):
+        d.tls = TLSSettings(
+            ca_file=src.get("GUBER_TLS_CA", ""),
+            cert_file=src.get("GUBER_TLS_CERT", ""),
+            key_file=src.get("GUBER_TLS_KEY", ""),
+            auto_tls=src.get("GUBER_TLS_AUTO", False, bool),
+            client_auth=src.get("GUBER_TLS_CLIENT_AUTH", "none"),
+            client_auth_ca_file=src.get("GUBER_TLS_CLIENT_AUTH_CA_CERT", ""),
+            insecure_skip_verify=src.get("GUBER_TLS_INSECURE_SKIP_VERIFY",
+                                         False, bool),
+        )
+    return d
+
+
+def parse_peer_list(specs: List[str], default_dc: str = "") -> List[PeerInfo]:
+    """"host:grpc_port[;host:http_port][@dc]" strings → PeerInfo list."""
+    out = []
+    for s in specs:
+        dc = default_dc
+        if "@" in s:
+            s, _, dc = s.partition("@")
+        grpc_addr, _, http_addr = s.partition(";")
+        out.append(PeerInfo(grpc_address=grpc_addr.strip(),
+                            http_address=http_addr.strip(),
+                            datacenter=dc.strip()))
+    return out

@@ -53,6 +53,24 @@ def mix64_np(x: np.ndarray) -> np.ndarray:
     return x
 
 
+def mix64(x: int) -> int:
+    """Scalar splitmix64 finalizer (same constants as mix64_np)."""
+    M = 0xFFFFFFFFFFFFFFFF
+    x &= M
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & M
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & M
+    x ^= x >> 31
+    return x
+
+
+def mixed_fnv1a64(data: bytes) -> int:
+    """FNV-1a + avalanche — uniform even on short similar keys (used by
+    the peer-picker ring, where raw FNV clusters badly)."""
+    return mix64(fnv1a64(data))
+
+
 def hash_key(name: str, unique_key: str) -> int:
     """64-bit identity hash of a rate limit, never 0."""
     h = int(mix64_np(np.array([fnv1a64((name + "_" + unique_key).encode("utf-8"))],

@@ -1,0 +1,81 @@
+"""Interval ticker driving the async managers.
+
+reference: interval.go › Interval (holster clock-based ticker used by
+global.go's runAsyncHits/runBroadcasts — reconstructed).  `wait()` blocks
+until the next period boundary or `stop()`; background managers loop on
+it.  A test clock can be injected for deterministic tests.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Interval:
+    """Periodic wakeup with early-fire support.
+
+    ``wait()`` returns True on a tick, False once stopped.  ``fire()``
+    wakes the waiter immediately (used to flush queues on demand or at
+    shutdown, like the reference's batch-full early flush).
+    """
+
+    def __init__(self, period_ms: int,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.period_s = max(period_ms, 1) / 1000.0
+        self._now = now_fn
+        self._ev = threading.Event()
+        self._stopped = False
+
+    def wait(self) -> bool:
+        if self._stopped:
+            return False
+        fired = self._ev.wait(self.period_s)
+        if self._stopped:
+            return False
+        if fired:
+            self._ev.clear()
+        return True
+
+    def fire(self) -> None:
+        self._ev.set()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._ev.set()
+
+
+class IntervalLoop:
+    """A daemon thread running ``fn()`` on every tick of an Interval.
+
+    The analog of the reference's `go manager.run()` goroutines; `close()`
+    runs one final ``fn()`` so pending queues flush at shutdown
+    (global.go drains before exit).
+    """
+
+    def __init__(self, period_ms: int, fn: Callable[[], None], name: str):
+        self.interval = Interval(period_ms)
+        self._fn = fn
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while self.interval.wait():
+            try:
+                self._fn()
+            except Exception:  # pragma: no cover - logged, loop survives
+                import logging
+
+                logging.getLogger("gubernator_tpu").exception(
+                    "interval loop %s", self._thread.name)
+
+    def poke(self) -> None:
+        self.interval.fire()
+
+    def close(self) -> None:
+        self.interval.stop()
+        self._thread.join(timeout=5)
+        try:
+            self._fn()  # final flush
+        except Exception:  # pragma: no cover
+            pass

@@ -1,0 +1,52 @@
+"""Address resolution helpers.
+
+reference: net.go › ResolveHostIP / advertise-address discovery
+(reconstructed).
+"""
+from __future__ import annotations
+
+import socket
+
+
+def split_host_port(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not port.isdigit():
+        raise ValueError(f"address must be host:port, got {addr!r}")
+    return host, int(port)
+
+
+def resolve_host_ip(addr: str) -> str:
+    """Resolve "host:port" to "ip:port"; 0.0.0.0/empty host becomes the
+    first non-loopback local IP (the reference's advertise-address
+    behavior when binding a wildcard)."""
+    host, port = split_host_port(addr)
+    if host in ("", "0.0.0.0", "::"):
+        ip = local_ip()
+    else:
+        try:
+            ip = socket.getaddrinfo(host, None, socket.AF_INET)[0][4][0]
+        except socket.gaierror:
+            ip = host
+    return f"{ip}:{port}"
+
+
+def local_ip() -> str:
+    """Best-effort non-loopback local IP (no packets are sent)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("192.0.2.1", 9))  # TEST-NET; connect() on UDP is local
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (test cluster harness helper)."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()

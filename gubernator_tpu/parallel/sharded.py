@@ -171,3 +171,51 @@ class ShardedEngine:
             # in original order for sequential parity.
             pending = sorted(rest)
         return responses
+
+    # ---- checkpoint/resume (store.py › Loader array fast path) ---------
+
+    def snapshot(self) -> dict:
+        """Device table → host column dict of live rows (Loader.save
+        input).  The analog of the reference's cache.Each() drain at
+        shutdown (store.go › Loader — reconstructed)."""
+        from ..store import table_to_arrays
+
+        return table_to_arrays(self.state)
+
+    def restore(self, arrays: dict) -> int:
+        """Insert snapshot rows into the (fresh) sharded table.
+
+        Host-side cold path: routes each row to its owner shard, places
+        it at its first free probe slot (same probe sequence as the
+        device kernel), then uploads the table once.  Returns rows
+        restored; rows that don't fit (capacity shrank) are dropped with
+        a count, mirroring the reference's best-effort Loader.Load.
+        """
+        from ..core.step import PROBES
+
+        host = {f: np.asarray(getattr(self.state, f)).copy()
+                for f in self.state._fields}
+        cap = self.cap_local
+        keys = arrays["key"].astype(np.uint64)
+        shard = shard_of(keys, self.n)
+        stride = (keys >> np.uint64(17)) | np.uint64(1)
+        placed = 0
+        for i in range(len(keys)):
+            base = int(shard[i]) * cap
+            k = keys[i]
+            for p in range(PROBES):
+                slot = base + int((k + np.uint64(p) * stride[i])
+                                  & np.uint64(cap - 1))
+                if host["key"][slot] == 0 or host["key"][slot] == k:
+                    for f in host:
+                        if f != "key":
+                            host[f][slot] = arrays[f][i]
+                    host["key"][slot] = k
+                    placed += 1
+                    break
+        sh = table_sharding(self.mesh)
+        from ..core.table import TableState
+
+        self.state = TableState(**{
+            f: jax.device_put(v, sh) for f, v in host.items()})
+        return placed

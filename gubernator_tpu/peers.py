@@ -1,0 +1,175 @@
+"""Peer picking: who owns a key.
+
+reference: hash.go › ConsistantHash (upstream spelling), replicated_hash.go
+› ReplicatedConsistentHash (virtual-node ring, default 512 replicas),
+region_picker.go › RegionPeerPicker — reconstructed, mount empty.
+
+Two layers of ownership exist in the TPU design (SURVEY.md §2.3):
+
+- **intra-node**: keys → device shards by hash range (hashing.shard_of),
+  invisible to peers;
+- **inter-node**: keys → daemon processes via these pickers, exactly like
+  the reference (forwarded over the peer wire protocol).
+
+Pickers map a key string to a peer object (anything carrying a
+``.info: PeerInfo``).  They are immutable once built — SetPeers builds a
+new picker and swaps it atomically (gubernator.go › SetPeers).
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Callable, Dict, Generic, List, Optional, Sequence, TypeVar
+
+from .hashing import fnv1a64, mixed_fnv1a64
+from .types import PeerInfo
+
+P = TypeVar("P")
+
+
+def crc64_hash(data: bytes) -> int:
+    """Alternate hash function option (reference offers fnv1/crc64)."""
+    # crc64 isn't in hashlib; use blake2b-8byte as the "other" option —
+    # pickers only need determinism + uniformity, and the choice is
+    # per-deployment, not wire-visible.
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+HashFn = Callable[[bytes], int]
+
+
+class ConsistentHash(Generic[P]):
+    """Modulo-style hash picker.
+
+    reference: hash.go › ConsistantHash — hashes each key and picks
+    ``peers[hash % len(peers)]`` over a sorted peer list.  Simple, even,
+    but remaps ~all keys on membership change; kept for parity, the
+    replicated ring below is the default.
+    """
+
+    def __init__(self, hash_fn: HashFn = mixed_fnv1a64):
+        self._hash = hash_fn
+        self._peers: List[P] = []
+        self._by_addr: Dict[str, P] = {}
+
+    def new(self) -> "ConsistentHash[P]":
+        return ConsistentHash(self._hash)
+
+    def add(self, peer: P) -> None:
+        self._peers.append(peer)
+        self._peers.sort(key=lambda p: p.info.grpc_address)  # type: ignore
+        self._by_addr[peer.info.grpc_address] = peer  # type: ignore
+
+    def peers(self) -> List[P]:
+        return list(self._peers)
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[P]:
+        return self._by_addr.get(info.grpc_address)
+
+    def get(self, key: str) -> P:
+        if not self._peers:
+            raise RuntimeError("picker has no peers")
+        h = self._hash(key.encode("utf-8"))
+        return self._peers[h % len(self._peers)]
+
+
+class ReplicatedConsistentHash(Generic[P]):
+    """Virtual-node hash ring.
+
+    reference: replicated_hash.go › ReplicatedConsistentHash — each peer
+    is hashed onto the ring ``replicas`` times (default 512); a key is
+    owned by the first ring point clockwise from its hash.  Membership
+    change only remaps keys adjacent to the changed peer's points.
+    """
+
+    DEFAULT_REPLICAS = 512
+
+    def __init__(self, hash_fn: HashFn = mixed_fnv1a64,
+                 replicas: int = DEFAULT_REPLICAS):
+        self._hash = hash_fn
+        self.replicas = replicas
+        self._ring: List[int] = []  # sorted ring point hashes
+        self._ring_peer: List[P] = []  # peer at same index
+        self._points: Dict[int, P] = {}
+        self._peers: List[P] = []
+        self._by_addr: Dict[str, P] = {}
+
+    def new(self) -> "ReplicatedConsistentHash[P]":
+        return ReplicatedConsistentHash(self._hash, self.replicas)
+
+    def add(self, peer: P) -> None:
+        addr = peer.info.grpc_address  # type: ignore
+        self._peers.append(peer)
+        self._by_addr[addr] = peer
+        for i in range(self.replicas):
+            h = self._hash(f"{addr}{i}".encode("utf-8"))
+            self._points[h] = peer
+        # rebuild sorted views
+        items = sorted(self._points.items())
+        self._ring = [h for h, _ in items]
+        self._ring_peer = [p for _, p in items]
+
+    def peers(self) -> List[P]:
+        return list(self._peers)
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[P]:
+        return self._by_addr.get(info.grpc_address)
+
+    def get(self, key: str) -> P:
+        if not self._ring:
+            raise RuntimeError("picker has no peers")
+        h = self._hash(key.encode("utf-8"))
+        idx = bisect.bisect_left(self._ring, h)
+        if idx == len(self._ring):
+            idx = 0
+        return self._ring_peer[idx]
+
+
+class RegionPeerPicker(Generic[P]):
+    """Datacenter-aware picker: one inner picker per region.
+
+    reference: region_picker.go › RegionPeerPicker — `get(key)` resolves
+    in the local region; `pickers()` exposes every region for the
+    multi-region manager's cross-DC fan-out (mutliregion.go).
+    """
+
+    def __init__(self, local_dc: str,
+                 make_picker: Callable[[], object] = ReplicatedConsistentHash):
+        self.local_dc = local_dc
+        self._make = make_picker
+        self.regions: Dict[str, object] = {}
+
+    def new(self) -> "RegionPeerPicker[P]":
+        return RegionPeerPicker(self.local_dc, self._make)
+
+    def add(self, peer: P) -> None:
+        dc = peer.info.datacenter or self.local_dc  # type: ignore
+        picker = self.regions.get(dc)
+        if picker is None:
+            picker = self._make()
+            self.regions[dc] = picker
+        picker.add(peer)  # type: ignore
+
+    def peers(self) -> List[P]:
+        out: List[P] = []
+        for picker in self.regions.values():
+            out.extend(picker.peers())  # type: ignore
+        return out
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[P]:
+        picker = self.regions.get(info.datacenter or self.local_dc)
+        return picker.get_by_peer_info(info) if picker else None  # type: ignore
+
+    def get(self, key: str) -> P:
+        picker = self.regions.get(self.local_dc)
+        if picker is None:
+            # no local-region peers: fall back to any region (degraded)
+            for picker in self.regions.values():
+                break
+            else:
+                raise RuntimeError("picker has no peers")
+        return picker.get(key)  # type: ignore
+
+    def get_in_region(self, key: str, dc: str) -> Optional[P]:
+        picker = self.regions.get(dc)
+        return picker.get(key) if picker else None  # type: ignore

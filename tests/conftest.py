@@ -20,6 +20,15 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh():
+    """Shared 4-device mesh (one compiled step program per mesh shape)."""
+    from gubernator_tpu.parallel import make_mesh
+
+    return make_mesh(n=4)

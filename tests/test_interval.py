@@ -1,0 +1,42 @@
+"""Interval ticker tests (reference: interval_test.go analog)."""
+import threading
+import time
+
+from gubernator_tpu.interval import Interval, IntervalLoop
+
+
+def test_interval_ticks_and_stops():
+    iv = Interval(period_ms=10)
+    assert iv.wait() is True  # period elapsed
+    iv.stop()
+    assert iv.wait() is False
+
+
+def test_interval_fire_wakes_early():
+    iv = Interval(period_ms=10_000)
+    t0 = time.monotonic()
+    threading.Timer(0.02, iv.fire).start()
+    assert iv.wait() is True
+    assert time.monotonic() - t0 < 5
+
+
+def test_interval_loop_runs_and_flushes_on_close():
+    calls = []
+    loop = IntervalLoop(5, lambda: calls.append(1), name="t")
+    time.sleep(0.08)
+    loop.close()
+    n = len(calls)
+    assert n >= 2  # ticked several times + final flush
+    time.sleep(0.03)
+    assert len(calls) == n  # no ticks after close
+
+
+def test_netutil():
+    from gubernator_tpu.netutil import free_port, resolve_host_ip, split_host_port
+
+    assert split_host_port("a.b.c:80") == ("a.b.c", 80)
+    assert resolve_host_ip("localhost:99").endswith(":99")
+    ip = resolve_host_ip("0.0.0.0:1051")
+    assert not ip.startswith("0.0.0.0")
+    p = free_port()
+    assert 1024 < p < 65536

@@ -1,0 +1,106 @@
+"""Peer-picker tests (reference: hash_test.go analog — distribution
+uniformity + stability under membership change)."""
+from dataclasses import dataclass, field
+
+import pytest
+
+from gubernator_tpu.peers import (
+    ConsistentHash,
+    RegionPeerPicker,
+    ReplicatedConsistentHash,
+    crc64_hash,
+)
+from gubernator_tpu.types import PeerInfo
+
+
+@dataclass
+class FakePeer:
+    info: PeerInfo = field(default_factory=PeerInfo)
+
+
+def mk_peers(n, dc=""):
+    return [FakePeer(PeerInfo(grpc_address=f"10.0.0.{i}:1051",
+                              datacenter=dc)) for i in range(n)]
+
+
+@pytest.mark.parametrize("picker_cls", [ConsistentHash, ReplicatedConsistentHash])
+def test_distribution_uniform(picker_cls):
+    """hash_test.go analog: keys spread evenly across peers."""
+    picker = picker_cls()
+    peers = mk_peers(8)
+    for p in peers:
+        picker.add(p)
+    counts = {p.info.grpc_address: 0 for p in peers}
+    n_keys = 50_000
+    for i in range(n_keys):
+        counts[picker.get(f"user_{i}").info.grpc_address] += 1
+    mean = n_keys / len(peers)
+    for addr, c in counts.items():
+        # modulo hash is near-perfect; ring with 512 replicas within ~40%
+        assert abs(c - mean) / mean < 0.45, (addr, c, mean)
+
+
+@pytest.mark.parametrize("picker_cls", [ConsistentHash, ReplicatedConsistentHash])
+def test_deterministic_across_instances(picker_cls):
+    a, b = picker_cls(), picker_cls()
+    for p in mk_peers(5):
+        a.add(p)
+    for p in mk_peers(5):
+        b.add(p)
+    for i in range(1000):
+        k = f"k{i}"
+        assert a.get(k).info.grpc_address == b.get(k).info.grpc_address
+
+
+def test_ring_minimal_remap():
+    """replicated_hash.go property: removing one of 8 peers remaps ~1/8
+    of keys, not all of them (unlike the modulo picker)."""
+    full = ReplicatedConsistentHash()
+    for p in mk_peers(8):
+        full.add(p)
+    small = ReplicatedConsistentHash()
+    for p in mk_peers(8)[:-1]:
+        small.add(p)
+    moved = sum(
+        1 for i in range(20_000)
+        if full.get(f"k{i}").info.grpc_address
+        != small.get(f"k{i}").info.grpc_address)
+    assert moved / 20_000 < 0.25  # ideal 1/8; allow slack
+
+
+def test_get_by_peer_info_and_new():
+    picker = ReplicatedConsistentHash()
+    peers = mk_peers(3)
+    for p in peers:
+        picker.add(p)
+    assert picker.get_by_peer_info(peers[1].info) is peers[1]
+    assert picker.get_by_peer_info(PeerInfo(grpc_address="nope:1")) is None
+    fresh = picker.new()
+    assert fresh.peers() == []
+    assert fresh.replicas == picker.replicas
+
+
+def test_alternate_hash_fn():
+    picker = ReplicatedConsistentHash(hash_fn=crc64_hash, replicas=64)
+    for p in mk_peers(4):
+        picker.add(p)
+    assert picker.get("some_key") in picker.peers()
+
+
+def test_empty_picker_raises():
+    for picker in (ConsistentHash(), ReplicatedConsistentHash(),
+                   RegionPeerPicker("dc1")):
+        with pytest.raises(RuntimeError):
+            picker.get("k")
+
+
+def test_region_picker():
+    picker = RegionPeerPicker("us-east")
+    east, west = mk_peers(3, "us-east"), mk_peers(2, "us-west")
+    for p in east + west:
+        picker.add(p)
+    assert len(picker.peers()) == 5
+    assert picker.get("k1") in east  # local-region resolution
+    assert picker.get_in_region("k1", "us-west") in west
+    assert picker.get_in_region("k1", "eu") is None
+    assert picker.get_by_peer_info(west[0].info) is west[0]

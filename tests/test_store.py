@@ -1,0 +1,117 @@
+"""Store/Loader persistence tests (reference: store_test.go analog) and
+engine snapshot/restore round-trip."""
+import numpy as np
+
+from gubernator_tpu.store import (
+    CacheItem,
+    FileLoader,
+    MockLoader,
+    MockStore,
+    arrays_from_items,
+    items_from_arrays,
+)
+from gubernator_tpu.types import Algorithm, RateLimitRequest, Status
+
+
+def test_mock_store_records_calls():
+    s = MockStore()
+    req = RateLimitRequest(name="a", unique_key="u", limit=5, duration=1000)
+    item = CacheItem(key=req.key, limit=5, remaining=4)
+    s.on_change(req, item)
+    assert s.called["on_change"] == 1
+    got = s.get(req)
+    assert s.called["get"] == 1 and got is item
+    s.remove(req.key)
+    assert s.called["remove"] == 1 and s.get(req) is None
+
+
+def test_mock_loader_round_trip():
+    ld = MockLoader()
+    items = [CacheItem(key=f"a_k{i}", limit=10, remaining=i) for i in range(5)]
+    ld.save(iter(items))
+    assert ld.called["save"] == 1
+    out = list(ld.load())
+    assert ld.called["load"] == 1
+    assert [i.remaining for i in out] == [0, 1, 2, 3, 4]
+
+
+def test_item_array_round_trip():
+    items = [
+        CacheItem(key="a_1", algorithm=int(Algorithm.LEAKY_BUCKET), limit=7,
+                  duration=5000, eff_ms=5000, burst=7, remaining=3 * 5000,
+                  t_ms=123, expire_at=456, status=int(Status.OVER_LIMIT)),
+        CacheItem(key="b_2", algorithm=int(Algorithm.TOKEN_BUCKET), limit=2,
+                  duration=100, eff_ms=100, remaining=1, t_ms=1, expire_at=101),
+    ]
+    arrays = arrays_from_items(items)
+    assert (arrays["key"] != 0).all()
+    back = items_from_arrays(arrays)
+    assert back[0].algorithm == int(Algorithm.LEAKY_BUCKET)
+    assert back[0].status == int(Status.OVER_LIMIT)
+    assert back[0].remaining == 3 * 5000
+    assert back[1].limit == 2
+    # key hashes must be the canonical identity hashes
+    from gubernator_tpu.hashing import hash_key
+
+    assert back[0].key_hash == hash_key("a", "1")
+
+
+def test_file_loader(tmp_path):
+    path = str(tmp_path / "snap" / "state.npz")
+    ld = FileLoader(path)
+    assert list(ld.load()) == []  # missing file → empty
+    items = [CacheItem(key=f"n_k{i}", limit=5, remaining=5 - i,
+                       duration=1000, eff_ms=1000, expire_at=10_000)
+             for i in range(3)]
+    ld.save(iter(items))
+    out = list(ld.load())
+    assert len(out) == 3
+    assert sorted(i.remaining for i in out) == [3, 4, 5]
+
+
+def test_engine_snapshot_restore(cpu_mesh):
+    """Shutdown snapshot → fresh engine restore → decisions continue
+    exactly where they left off (daemon.go › Loader wiring analog)."""
+    from gubernator_tpu.parallel import ShardedEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    now = 1_760_000_000_000
+    reqs = [RateLimitRequest(name="s", unique_key=f"k{i}", hits=3, limit=5,
+                             duration=60_000) for i in range(40)]
+    eng = ShardedEngine(cpu_mesh, capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+    r1 = eng.check_batch(reqs, now)
+    assert all(r.remaining == 2 for r in r1)
+    snap = eng.snapshot()
+    assert len(snap["key"]) == 40
+
+    eng2 = ShardedEngine(cpu_mesh, capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+    placed = eng2.restore(snap)
+    assert placed == 40
+    # 3 more hits: 2 remaining → OVER_LIMIT, remaining stays 2
+    r2 = eng2.check_batch(reqs, now + 1000)
+    assert all(int(r.status) == int(Status.OVER_LIMIT) for r in r2)
+    assert all(r.remaining == 2 for r in r2)
+
+
+def test_snapshot_npz_round_trip(tmp_path, cpu_mesh):
+    from gubernator_tpu.parallel import ShardedEngine
+    from gubernator_tpu.store import save_arrays
+
+    now = 1_760_000_000_000
+    eng = ShardedEngine(cpu_mesh, capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+    eng.check_batch(
+        [RateLimitRequest(name="z", unique_key=f"k{i}", hits=1, limit=9,
+                          duration=30_000) for i in range(10)], now)
+    path = str(tmp_path / "s.npz")
+    save_arrays(path, eng.snapshot())
+    arrays = dict(np.load(path))
+    eng2 = ShardedEngine(cpu_mesh, capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+    assert eng2.restore(arrays) == 10
+    r = eng2.check_batch(
+        [RateLimitRequest(name="z", unique_key="k3", hits=0, limit=9,
+                          duration=30_000)], now + 5)
+    assert r[0].remaining == 8

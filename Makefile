@@ -1,0 +1,28 @@
+# gubernator-tpu build/test targets (reference: Makefile).
+
+PY ?= python
+
+.PHONY: test proto bench daemon cluster lint native clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+proto:
+	cd gubernator_tpu/proto && protoc -I. --python_out=. \
+	    gubernator.proto peers.proto
+
+bench:
+	$(PY) bench.py
+
+daemon:
+	$(PY) -m gubernator_tpu.cmd.daemon --config example.conf
+
+cluster:
+	$(PY) -m gubernator_tpu.cmd.cluster --count 4
+
+native:
+	$(PY) gubernator_tpu/ops/setup_native.py build_ext --inplace
+
+clean:
+	rm -rf build dist *.egg-info gubernator_tpu/ops/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

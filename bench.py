@@ -122,6 +122,8 @@ def main():
     hash_keys(names)
     hash_mkeys = len(names) / (time.perf_counter() - t0) / 1e6
 
+    configs = run_secondary_configs(jnp, decide_batch, const)
+
     print(json.dumps({
         "metric": "rate-limit decisions/sec/chip @1M-key Zipf(1.1)",
         "value": round(dps),
@@ -135,8 +137,129 @@ def main():
             "backend": backend,
             "config": f"TOKEN_BUCKET {N_KEYS} keys Zipf({ZIPF_A}) hits=1 CAP={CAP}",
             "baseline_is": "north-star target 50M decisions/s/chip (no published reference numbers; BASELINE.md)",
+            "baseline_configs": configs,
         },
     }))
+
+
+def _sustain(decide_batch, jnp, state, batches, reps, now0):
+    """Measure a sustained dispatch loop → decisions/s."""
+    i64 = jnp.int64
+    out = None
+    t0 = time.perf_counter()
+    for r in range(reps):
+        state, out = decide_batch(state, batches[r % len(batches)],
+                                  jnp.asarray(now0 + r, i64))
+    out.status.block_until_ready()
+    dt = time.perf_counter() - t0
+    return reps * batches[0].key.shape[0] / dt, state
+
+
+def run_secondary_configs(jnp, decide_batch, const_proto):
+    """BASELINE.md configs 1/2/4/5 (config 3 is the headline above).
+    Smaller rep counts — these document shape coverage, not the record."""
+    import jax
+
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.core.table import init_table
+    from gubernator_tpu.gregorian import gregorian_expiration
+    from gubernator_tpu.types import Behavior, GregorianDuration
+
+    i64, i32 = jnp.int64, jnp.int32
+    out = {}
+    rng = np.random.default_rng(7)
+
+    def mk(keys, **over):
+        B2 = keys.shape[0]
+        cols = dict(
+            hits=jnp.ones(B2, i64), limit=jnp.full(B2, LIMIT, i64),
+            duration=jnp.full(B2, DURATION_MS, i64),
+            eff_ms=jnp.full(B2, DURATION_MS, i64),
+            greg_end=jnp.zeros(B2, i64), behavior=jnp.zeros(B2, i32),
+            algorithm=jnp.zeros(B2, i32), burst=jnp.full(B2, LIMIT, i64),
+            valid=jnp.ones(B2, bool))
+        cols.update(over)
+        return RequestBatch(key=jnp.asarray(keys), **cols)
+
+    # -- config 1: single key, TOKEN_BUCKET (examples_test.go smoke).
+    # Every request in the batch is the same key: the worst case for the
+    # duplicate-segment path (one segment of length B).
+    Bs = 4096
+    keys1 = np.full(Bs, 12345, np.uint64)
+    st = init_table(1 << 12)
+    b = mk(keys1, limit=jnp.full(Bs, 10**9, i64))
+    st, _ = decide_batch(st, b, jnp.asarray(NOW0, i64))  # compile
+    dps1, _ = _sustain(decide_batch, jnp, st, [b], 20, NOW0 + 1)
+    out["1_single_key_smoke"] = {"decisions_per_s": round(dps1)}
+
+    # -- config 2: LEAKY_BUCKET, 1k keys uniform.
+    keys2 = _keyhash(rng.integers(0, 1000, size=Bs).astype(np.uint64))
+    st = init_table(1 << 12)
+    b2 = mk(keys2, algorithm=jnp.ones(Bs, i32),
+            limit=jnp.full(Bs, 10**6, i64), burst=jnp.full(Bs, 10**6, i64),
+            duration=jnp.full(Bs, 60_000, i64),
+            eff_ms=jnp.full(Bs, 60_000, i64))
+    st, _ = decide_batch(st, b2, jnp.asarray(NOW0, i64))
+    dps2, _ = _sustain(decide_batch, jnp, st, [b2], 20, NOW0 + 1)
+    out["2_leaky_1k_keys"] = {"decisions_per_s": round(dps2)}
+
+    # -- config 4: GLOBAL multi-peer ≙ sharded mesh step over all local
+    # devices (4-chip ICI on a pod; 1 chip here → measures shard_map
+    # overhead on the same program).
+    try:
+        from gubernator_tpu.parallel import make_mesh
+        from gubernator_tpu.parallel.sharded import make_sharded_step
+        from gubernator_tpu.parallel.mesh import shard_table
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh()
+        n = mesh.shape["shard"]
+        step = make_sharded_step(mesh)
+        stg = shard_table(mesh, 1 << 18)
+        Bg = 16384 * n
+        keysg = _keyhash(rng.zipf(ZIPF_A, size=Bg) % 100_000)
+        bg = mk(keysg)
+        sh = NamedSharding(mesh, P("shard"))
+        bg = RequestBatch(*[jax.device_put(np.asarray(x), sh) for x in bg])
+        stg, o, _ = step(stg, bg, jnp.asarray(NOW0, i64))
+        t0 = time.perf_counter()
+        reps = 20
+        for r in range(reps):
+            stg, o, _ = step(stg, bg, jnp.asarray(NOW0 + 1 + r, i64))
+        o[0].block_until_ready()
+        dps4 = reps * Bg / (time.perf_counter() - t0)
+        out["4_global_sharded"] = {"decisions_per_s": round(dps4),
+                                   "n_shards": int(n)}
+    except Exception as e:  # noqa: BLE001
+        out["4_global_sharded"] = {"error": str(e)[:200]}
+
+    # -- config 5: huge multi-tenant table, Gregorian resets +
+    # RESET_REMAINING churn.  Capacity scaled to HBM (~72 B/row).
+    try:
+        cap5 = 1 << 27  # 134M rows ≈ 9.7 GB
+        if jax.default_backend() == "cpu":
+            cap5 = 1 << 22
+        n_keys5 = int(cap5 * 0.75)
+        st5 = init_table(cap5)
+        greg_end = gregorian_expiration(NOW0, int(GregorianDuration.HOURS))
+        beh = int(Behavior.DURATION_IS_GREGORIAN)
+        batches = []
+        for i in range(4):
+            k = _keyhash(rng.integers(0, n_keys5, size=B).astype(np.uint64))
+            beh_col = np.full(B, beh, np.int32)
+            beh_col[:: 37] |= int(Behavior.RESET_REMAINING)  # churn
+            batches.append(mk(
+                k, duration=jnp.full(B, int(GregorianDuration.HOURS), i64),
+                eff_ms=jnp.full(B, 3_600_000, i64),
+                greg_end=jnp.full(B, greg_end, i64),
+                behavior=jnp.asarray(beh_col)))
+        st5, _ = decide_batch(st5, batches[0], jnp.asarray(NOW0, i64))
+        dps5, _ = _sustain(decide_batch, jnp, st5, batches, 16, NOW0 + 1)
+        out["5_gregorian_churn"] = {"decisions_per_s": round(dps5),
+                                    "capacity": cap5}
+    except Exception as e:  # noqa: BLE001
+        out["5_gregorian_churn"] = {"error": str(e)[:200]}
+    return out
 
 
 if __name__ == "__main__":

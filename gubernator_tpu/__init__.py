@@ -28,3 +28,29 @@ from .types import (  # noqa: F401
     Status,
 )
 from .oracle import Oracle  # noqa: F401
+
+# Service layer (lazy-import-safe: these pull in grpc/jax on use).
+from .config import (  # noqa: F401
+    BehaviorConfig,
+    Config,
+    DaemonConfig,
+    setup_daemon_config,
+)
+from .store import CacheItem, FileLoader, MockLoader, MockStore  # noqa: F401
+
+
+def __getattr__(name):
+    """Lazy heavyweight exports: V1Instance, Daemon, spawn_daemon, Client."""
+    if name in ("V1Instance",):
+        from .instance import V1Instance
+
+        return V1Instance
+    if name in ("Daemon", "spawn_daemon"):
+        from . import daemon
+
+        return getattr(daemon, name)
+    if name in ("Client", "HttpClient"):
+        from . import client
+
+        return getattr(client, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,110 @@
+"""Load-test CLI: fire GetRateLimits traffic, report latency/throughput.
+
+reference: cmd/gubernator-cli/main.go — reconstructed, mount empty.
+Usage: python -m gubernator_tpu.cmd.cli --address host:port
+       [--rate-limits N] [--concurrency C] [--batch B] [--duration S]
+       [--zipf A] [--http]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="gubernator-tpu load tester")
+    ap.add_argument("--address", default="localhost:1051")
+    ap.add_argument("--http", action="store_true",
+                    help="use the HTTP/JSON gateway instead of gRPC")
+    ap.add_argument("--rate-limits", type=int, default=100_000,
+                    help="distinct keys")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=1000)
+    ap.add_argument("--duration", type=float, default=10.0, help="seconds")
+    ap.add_argument("--zipf", type=float, default=1.1,
+                    help="Zipf skew (0 = uniform)")
+    ap.add_argument("--limit", type=int, default=100)
+    ap.add_argument("--window", type=int, default=10_000, help="ms")
+    ap.add_argument("--json", action="store_true", help="one-line JSON out")
+    args = ap.parse_args(argv)
+
+    from ..client import Client, HttpClient
+    from ..types import RateLimitRequest
+
+    def draw_keys(rng, n):
+        if args.zipf > 1.0:
+            return rng.zipf(args.zipf, size=n) % args.rate_limits
+        return rng.integers(0, args.rate_limits, size=n)
+
+    def mk_client():
+        if args.http:
+            return HttpClient(f"http://{args.address}")
+        return Client(args.address)
+
+    stop = time.monotonic() + args.duration
+    lats: list = []
+    counts = [0] * args.concurrency
+    over = [0] * args.concurrency
+    errs: list = []
+    lock = threading.Lock()
+
+    def worker(w: int):
+        c = mk_client()
+        rng = np.random.default_rng(w)  # Generator is not thread-safe
+        while time.monotonic() < stop:
+            keys = draw_keys(rng, args.batch)
+            reqs = [RateLimitRequest(
+                name="load", unique_key=f"k{k}", hits=1, limit=args.limit,
+                duration=args.window) for k in keys]
+            t0 = time.perf_counter()
+            try:
+                resps = c.get_rate_limits(reqs)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errs.append(str(e))
+                return
+            dt = time.perf_counter() - t0
+            counts[w] += len(resps)
+            over[w] += sum(1 for r in resps if int(r.status) == 1)
+            with lock:
+                lats.append(dt * 1000)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(args.concurrency)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+
+    total = sum(counts)
+    out = {
+        "decisions": total,
+        "decisions_per_s": round(total / max(elapsed, 1e-9)),
+        "over_limit": sum(over),
+        "p50_ms": round(float(np.percentile(lats, 50)), 3) if lats else None,
+        "p99_ms": round(float(np.percentile(lats, 99)), 3) if lats else None,
+        "batch": args.batch,
+        "concurrency": args.concurrency,
+        "errors": errs[:3],
+    }
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"decisions: {out['decisions']} "
+              f"({out['decisions_per_s']}/s)  over_limit: {out['over_limit']}")
+        print(f"latency: p50={out['p50_ms']}ms p99={out['p99_ms']}ms "
+              f"(batch={args.batch} x{args.concurrency} workers)")
+        for e in errs[:3]:
+            print("ERROR:", e, file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""Boot a local N-daemon cluster (development tool).
+
+reference: cmd/gubernator-cluster/main.go — reconstructed, mount empty.
+Usage: python -m gubernator_tpu.cmd.cluster [--count N] [--base-port P]
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="local gubernator-tpu cluster")
+    ap.add_argument("--count", type=int, default=4)
+    ap.add_argument("--base-port", type=int, default=9080)
+    ap.add_argument("--cache-size", type=int, default=1 << 16)
+    args = ap.parse_args(argv)
+
+    from ..cluster import start_with
+    from ..config import DaemonConfig
+
+    cfgs = [DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{args.base_port + 2 * i}",
+        http_listen_address=f"127.0.0.1:{args.base_port + 2 * i + 1}",
+        cache_size=args.cache_size) for i in range(args.count)]
+    c = start_with(cfgs)
+    for i, d in enumerate(c.daemons):
+        print(f"daemon[{i}] grpc={d.cfg.grpc_listen_address} "
+              f"http={d.cfg.http_listen_address}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    c.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""The daemon binary: config → spawn → wait for signal.
+
+reference: cmd/gubernator/main.go — reconstructed, mount empty.
+Usage: python -m gubernator_tpu.cmd.daemon [--config FILE]
+(all GUBER_* env vars apply; see config.py).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="gubernator-tpu daemon")
+    ap.add_argument("--config", default="", help="KEY=value config file")
+    ap.add_argument("--grpc", default="", help="override GUBER_GRPC_ADDRESS")
+    ap.add_argument("--http", default="", help="override GUBER_HTTP_ADDRESS")
+    args = ap.parse_args(argv)
+
+    # Optional backend pin (GUBER_JAX_PLATFORM=cpu|tpu).  Must go through
+    # jax.config: some sandboxes overwrite the jax_platforms config at
+    # interpreter start, so the JAX_PLATFORMS env var alone is ignored.
+    import os
+
+    plat = os.environ.get("GUBER_JAX_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    from ..config import setup_daemon_config
+    from ..daemon import spawn_daemon
+
+    cfg = setup_daemon_config(conf_file=args.config)
+    if args.grpc:
+        cfg.grpc_listen_address = args.grpc
+    if args.http:
+        cfg.http_listen_address = args.http
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    d = spawn_daemon(cfg)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    print(f"gubernator-tpu listening grpc={cfg.grpc_listen_address} "
+          f"http={cfg.http_listen_address}", flush=True)
+    stop.wait()
+    d.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

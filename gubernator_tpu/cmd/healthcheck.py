@@ -1,0 +1,33 @@
+"""Container healthcheck: exit 0 iff the daemon reports healthy.
+
+reference: cmd/healthcheck/main.go — reconstructed, mount empty.
+Usage: python -m gubernator_tpu.cmd.healthcheck [--url URL]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default="http://localhost:1050/v1/HealthCheck")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    try:
+        with urllib.request.urlopen(args.url, timeout=args.timeout) as f:
+            body = json.loads(f.read())
+    except Exception as e:  # noqa: BLE001
+        print(f"unhealthy: {e}", file=sys.stderr)
+        return 1
+    if body.get("status") != "healthy":
+        print(f"unhealthy: {body}", file=sys.stderr)
+        return 1
+    print("healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

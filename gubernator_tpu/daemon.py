@@ -1,0 +1,267 @@
+"""Daemon: listeners + lifecycle around one V1Instance.
+
+reference: daemon.go › Daemon / SpawnDaemon — reconstructed, mount
+empty.  Serves:
+
+- gRPC V1 + PeersV1 on ``grpc_listen_address`` (TLS optional),
+- an HTTP/JSON gateway on ``http_listen_address`` mirroring the
+  reference's grpc-gateway mux: POST /v1/GetRateLimits,
+  GET /v1/HealthCheck, plus GET /metrics (prometheus) and GET /healthz,
+- the configured discovery source wired to instance.set_peers.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import grpc
+
+from .config import DaemonConfig
+from .discovery import make_discovery
+from .grpc_api import add_peers_servicer, add_v1_servicer
+from .instance import V1Instance
+from .netutil import resolve_host_ip, split_host_port
+from .proto import gubernator_pb2 as pb
+from .proto import peers_pb2 as peers_pb
+from .store import FileLoader
+from .tlsutil import setup_tls
+from .types import Behavior, PeerInfo, RateLimitRequest
+from .wire import health_to_pb, req_from_pb, resp_to_pb
+
+log = logging.getLogger("gubernator_tpu.daemon")
+
+
+class _V1Servicer:
+    def __init__(self, instance: V1Instance):
+        self.instance = instance
+
+    def GetRateLimits(self, request: pb.GetRateLimitsReq, context):
+        try:
+            reqs = [req_from_pb(m) for m in request.requests]
+            resps = self.instance.get_rate_limits(reqs)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        out = pb.GetRateLimitsResp()
+        out.responses.extend(resp_to_pb(r) for r in resps)
+        return out
+
+    def HealthCheck(self, request: pb.HealthCheckReq, context):
+        return health_to_pb(self.instance.health_check())
+
+
+class _PeersServicer:
+    def __init__(self, instance: V1Instance):
+        self.instance = instance
+
+    def GetPeerRateLimits(self, request: peers_pb.GetPeerRateLimitsReq,
+                          context):
+        try:
+            reqs = [req_from_pb(m) for m in request.requests]
+            resps = self.instance.get_peer_rate_limits(reqs)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        out = peers_pb.GetPeerRateLimitsResp()
+        out.rate_limits.extend(resp_to_pb(r) for r in resps)
+        return out
+
+    def UpdatePeerGlobals(self, request: peers_pb.UpdatePeerGlobalsReq,
+                          context):
+        self.instance.update_peer_globals(list(request.globals))
+        return peers_pb.UpdatePeerGlobalsResp()
+
+
+def _json_to_req(o: dict) -> RateLimitRequest:
+    """Accept both snake_case and grpc-gateway camelCase field names."""
+
+    def g(*names, default=None):
+        for n in names:
+            if n in o:
+                return o[n]
+        return default
+
+    return RateLimitRequest(
+        name=g("name", default=""),
+        unique_key=g("unique_key", "uniqueKey", default=""),
+        hits=int(g("hits", default=1)),
+        limit=int(g("limit", default=0)),
+        duration=int(g("duration", default=0)),
+        algorithm=int(g("algorithm", default=0)),
+        behavior=Behavior(int(g("behavior", default=0))),
+        burst=int(g("burst", default=0)),
+        metadata=g("metadata", default={}) or {},
+    )
+
+
+def _resp_to_json(r) -> dict:
+    return {"status": int(r.status), "limit": r.limit,
+            "remaining": r.remaining, "reset_time": r.reset_time,
+            "error": r.error, "metadata": r.metadata}
+
+
+class Daemon:
+    """reference: daemon.go › Daemon.  Use spawn_daemon() to construct."""
+
+    def __init__(self, cfg: DaemonConfig, mesh=None, engine=None):
+        self.cfg = cfg
+        self.tls = setup_tls(cfg.tls)
+        self._closed = False
+        self.instance: Optional[V1Instance] = None
+        self.discovery = None
+        self.http_server: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+        # --- gRPC listener FIRST: an ephemeral port (":0") must be
+        # resolved to the real bound port before the advertise address
+        # (and thus peer identity / discovery) is derived from it.
+        self.grpc_server = grpc.server(
+            ThreadPoolExecutor(max_workers=32),
+            options=[("grpc.so_reuseport", 0)])
+        if self.tls is not None:
+            bound = self.grpc_server.add_secure_port(
+                cfg.grpc_listen_address, self.tls.grpc_server_credentials())
+        else:
+            bound = self.grpc_server.add_insecure_port(cfg.grpc_listen_address)
+        if bound == 0:
+            raise OSError(f"failed to bind {cfg.grpc_listen_address}")
+        self.grpc_port = bound
+
+        try:
+            icfg = cfg.instance_config()
+            host, _ = split_host_port(cfg.grpc_listen_address)
+            adv = icfg.advertise_address or f"{host}:{bound}"
+            adv_host, adv_port = split_host_port(adv)
+            if adv_port == 0:
+                adv = f"{adv_host}:{bound}"
+            icfg.advertise_address = resolve_host_ip(adv)
+            self.advertise_address = icfg.advertise_address
+            if cfg.snapshot_path:
+                icfg.loader = FileLoader(cfg.snapshot_path)
+            peer_creds = (self.tls.grpc_client_credentials()
+                          if self.tls is not None else None)
+            self.instance = V1Instance(icfg, mesh=mesh, engine=engine,
+                                       peer_tls_creds=peer_creds)
+            # Warm-up: compile the device step before serving (first
+            # compile is tens of seconds; an RPC must not eat that).
+            self.instance.get_rate_limits(
+                [RateLimitRequest(name="_warmup", unique_key="w", hits=0,
+                                  limit=1, duration=1000)])
+            add_v1_servicer(self.grpc_server, _V1Servicer(self.instance))
+            add_peers_servicer(self.grpc_server, _PeersServicer(self.instance))
+            self.grpc_server.start()
+
+            if cfg.http_listen_address:
+                self._start_http(cfg.http_listen_address)
+
+            self_info = PeerInfo(grpc_address=self.advertise_address,
+                                 http_address=cfg.http_listen_address,
+                                 datacenter=cfg.data_center)
+            self.discovery = make_discovery(cfg, self_info,
+                                            self.instance.set_peers)
+        except BaseException:
+            # Don't leak live listeners/threads from a half-built daemon.
+            self._teardown()
+            raise
+
+    # ---- HTTP gateway ---------------------------------------------------
+
+    def _start_http(self, addr: str) -> None:
+        host, port = split_host_port(addr)
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                log.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, daemon.instance.metrics.render(),
+                               "text/plain; version=0.0.4")
+                elif self.path in ("/v1/HealthCheck", "/healthz"):
+                    h = daemon.instance.health_check()
+                    code = 200 if h.status == "healthy" else 503
+                    self._send(code, json.dumps({
+                        "status": h.status, "message": h.message,
+                        "peer_count": h.peer_count}).encode())
+                else:
+                    self._send(404, b'{"error":"not found"}')
+
+            def do_POST(self):
+                if self.path not in ("/v1/GetRateLimits",
+                                     "/v1/V1/GetRateLimits"):
+                    self._send(404, b'{"error":"not found"}')
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    reqs = [_json_to_req(o)
+                            for o in payload.get("requests", [])]
+                    resps = daemon.instance.get_rate_limits(reqs)
+                except ValueError as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                self._send(200, json.dumps({
+                    "responses": [_resp_to_json(r) for r in resps]}).encode())
+
+        self.http_server = ThreadingHTTPServer((host, port), Handler)
+        if self.tls is not None:
+            self.http_server.socket = self.tls.http_ssl_context().wrap_socket(
+                self.http_server.socket, server_side=True)
+        self.http_port = self.http_server.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self.http_server.serve_forever, daemon=True,
+            name=f"http-{addr}")
+        self._http_thread.start()
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def set_peers(self, infos: List[PeerInfo]) -> None:
+        self.instance.set_peers(infos)
+
+    def peer_info(self) -> PeerInfo:
+        return PeerInfo(grpc_address=self.advertise_address,
+                        http_address=self.cfg.http_listen_address,
+                        datacenter=self.cfg.data_center)
+
+    def close(self) -> None:
+        """Graceful shutdown (daemon.go › Daemon.Close, SURVEY.md §3.5).
+
+        Listeners stop FIRST so no request lands after the instance has
+        flushed its async managers and written the Loader snapshot —
+        mutations during the shutdown window would be lost on restart."""
+        if self._closed:
+            return
+        self._closed = True
+        self._teardown()
+
+    def _teardown(self) -> None:
+        if self.discovery is not None:
+            self.discovery.close()
+        self.grpc_server.stop(grace=2).wait(timeout=5)
+        if self.http_server is not None:
+            self.http_server.shutdown()
+            self.http_server.server_close()
+        if self.instance is not None:
+            self.instance.close()
+
+
+def spawn_daemon(cfg: DaemonConfig, mesh=None, engine=None) -> Daemon:
+    """reference: daemon.go › SpawnDaemon."""
+    d = Daemon(cfg, mesh=mesh, engine=engine)
+    log.info("gubernator-tpu daemon up: grpc=%s http=%s advertise=%s",
+             cfg.grpc_listen_address, cfg.http_listen_address,
+             d.advertise_address)
+    return d

@@ -1,0 +1,323 @@
+"""Peer discovery sources: watch membership → SetPeers callback.
+
+reference: etcd.go › EtcdPool, memberlist.go › MemberListPool,
+kubernetes.go › K8sPool, dns.go › DNSPool — reconstructed, mount empty.
+Each source resolves the current peer set and fires ``on_change`` with a
+full []PeerInfo whenever it differs from the last one; the daemon wires
+the callback to V1Instance.set_peers (SURVEY.md §3.4).
+
+Implemented natively here: static, file-watch, and DNS polling.  The
+etcd/Kubernetes pools require their client libraries (not in this image)
+and degrade to a clear error; memberlist-style gossip is provided by
+``GossipDiscovery`` — a small UDP full-mesh heartbeat protocol, the
+in-tree analog of hashicorp/memberlist for lab clusters.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from .config import DaemonConfig, parse_peer_list
+from .interval import IntervalLoop
+from .types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.discovery")
+
+OnChange = Callable[[List[PeerInfo]], None]
+
+
+class Discovery:
+    """Base: deduped change notification.  The lock serializes
+    concurrent notifiers (e.g. gossip rx thread vs. tx tick) so a stale
+    membership can never be applied after a newer one."""
+
+    def __init__(self, on_change: OnChange):
+        self._on_change = on_change
+        self._last: Optional[tuple] = None
+        self._notify_mu = threading.Lock()
+
+    def _notify(self, peers: Sequence[PeerInfo]) -> None:
+        key = tuple(sorted((p.grpc_address, p.http_address, p.datacenter)
+                           for p in peers))
+        with self._notify_mu:
+            if key == self._last:
+                return
+            self._last = key
+            self._on_change(list(peers))
+
+    def close(self) -> None:  # pragma: no cover - overridden
+        pass
+
+
+class StaticDiscovery(Discovery):
+    """Fixed peer list from config (GUBER_PEERS)."""
+
+    def __init__(self, on_change: OnChange, peers: Sequence[PeerInfo]):
+        super().__init__(on_change)
+        self._notify(peers)
+
+
+class FileDiscovery(Discovery):
+    """Re-read a peers file on mtime change.  File format: one
+    "grpc_addr[;http_addr][@dc]" per line, or a JSON array of objects."""
+
+    def __init__(self, on_change: OnChange, path: str,
+                 poll_interval_ms: int = 3000, default_dc: str = ""):
+        super().__init__(on_change)
+        self.path = path
+        self.default_dc = default_dc
+        self._mtime = -1.0
+        self._poll()
+        self._loop = IntervalLoop(poll_interval_ms, self._poll,
+                                  name="file-discovery")
+
+    def _poll(self) -> None:
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return
+        if mtime == self._mtime:
+            return
+        self._mtime = mtime
+        with open(self.path) as f:
+            text = f.read()
+        text_s = text.strip()
+        if text_s.startswith("["):
+            peers = [PeerInfo(grpc_address=o.get("grpc_address", ""),
+                              http_address=o.get("http_address", ""),
+                              datacenter=o.get("datacenter", self.default_dc))
+                     for o in json.loads(text_s)]
+        else:
+            lines = [ln.strip() for ln in text.splitlines()
+                     if ln.strip() and not ln.strip().startswith("#")]
+            peers = parse_peer_list(lines, self.default_dc)
+        self._notify(peers)
+
+    def close(self) -> None:
+        self._loop.close()
+
+
+class DnsDiscovery(Discovery):
+    """Periodic A/AAAA resolution of one FQDN (dns.go › DNSPool analog):
+    every resolved address is a peer at ``grpc_port``."""
+
+    def __init__(self, on_change: OnChange, fqdn: str, grpc_port: int,
+                 poll_interval_ms: int = 30_000, default_dc: str = ""):
+        super().__init__(on_change)
+        self.fqdn = fqdn
+        self.grpc_port = grpc_port
+        self.default_dc = default_dc
+        self._poll()
+        self._loop = IntervalLoop(poll_interval_ms, self._poll,
+                                  name="dns-discovery")
+
+    def _poll(self) -> None:
+        try:
+            infos = socket.getaddrinfo(self.fqdn, self.grpc_port,
+                                       proto=socket.IPPROTO_TCP)
+        except socket.gaierror as e:
+            log.warning("dns discovery %s: %s", self.fqdn, e)
+            return
+        addrs = sorted({i[4][0] for i in infos})
+        self._notify([PeerInfo(
+            # IPv6 literals need brackets to form a valid host:port target
+            grpc_address=(f"[{a}]:{self.grpc_port}" if ":" in a
+                          else f"{a}:{self.grpc_port}"),
+            datacenter=self.default_dc) for a in addrs])
+
+    def close(self) -> None:
+        self._loop.close()
+
+
+class GossipDiscovery(Discovery):
+    """Minimal UDP heartbeat membership — the in-tree stand-in for
+    hashicorp/memberlist (memberlist.go › MemberListPool analog).
+
+    Every node broadcasts {self, known peers, incarnation} to all known
+    peers each interval; peers not heard from within ``suspect_ms`` are
+    dropped.  Full-mesh heartbeats (not SWIM sampling) — fine for the
+    tens-of-nodes clusters the reference targets.
+    """
+
+    def __init__(self, on_change: OnChange, bind: str, self_info: PeerInfo,
+                 known_hosts: Sequence[str], interval_ms: int = 1000,
+                 suspect_ms: int = 5000):
+        super().__init__(on_change)
+        self.self_info = self_info
+        host, _, port = bind.rpartition(":")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host or "0.0.0.0", int(port)))
+        self._sock.settimeout(0.25)
+        self.gossip_addr = f"{host or '127.0.0.1'}:{self._sock.getsockname()[1]}"
+        self.suspect_s = suspect_ms / 1000.0
+        #: gossip_addr → (PeerInfo dict, last_seen monotonic); guarded by
+        #: _members_mu (written by the rx thread, read by the tx tick).
+        self._members: dict = {}
+        self._members_mu = threading.Lock()
+        self._seeds = list(known_hosts)
+        self._stop = threading.Event()
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True,
+                                    name="gossip-rx")
+        self._rx.start()
+        self._loop = IntervalLoop(interval_ms, self._tick, name="gossip-tx")
+        self._notify([self_info])
+
+    def _payload(self) -> bytes:
+        now = time.monotonic()
+        members = {self.gossip_addr: _peer_dict(self.self_info)}
+        with self._members_mu:
+            snapshot = list(self._members.items())
+        for addr, (info, seen) in snapshot:
+            if now - seen <= self.suspect_s:
+                members[addr] = info
+        return json.dumps({"from": self.gossip_addr,
+                           "members": members}).encode()
+
+    def _tick(self) -> None:
+        payload = self._payload()
+        with self._members_mu:
+            known = set(self._members.keys())
+        targets = set(self._seeds) | known
+        for t in targets:
+            if t == self.gossip_addr:
+                continue
+            host, _, port = t.rpartition(":")
+            try:
+                self._sock.sendto(payload, (host, int(port)))
+            except OSError:
+                pass
+        self._prune_and_notify()
+
+    def _recv_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, _ = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg = json.loads(data)
+            except ValueError:
+                continue
+            now = time.monotonic()
+            with self._members_mu:
+                for addr, info in msg.get("members", {}).items():
+                    if addr != self.gossip_addr:
+                        self._members[addr] = (info, now)
+            self._prune_and_notify()
+
+    def _prune_and_notify(self) -> None:
+        """Drop peers past the suspect window (really drop them — a
+        read-time filter alone would heartbeat dead addresses forever)."""
+        now = time.monotonic()
+        with self._members_mu:
+            dead = [a for a, (_, seen) in self._members.items()
+                    if now - seen > self.suspect_s]
+            for a in dead:
+                del self._members[a]
+            live = [_peer_info(i) for i, _ in self._members.values()]
+        self._notify(sorted(live + [self.self_info],
+                            key=lambda p: p.grpc_address))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._loop.close()
+        self._rx.join(timeout=2)
+        self._sock.close()
+
+
+def _peer_dict(p: PeerInfo) -> dict:
+    return {"grpc_address": p.grpc_address, "http_address": p.http_address,
+            "datacenter": p.datacenter}
+
+
+def _peer_info(d: dict) -> PeerInfo:
+    return PeerInfo(grpc_address=d.get("grpc_address", ""),
+                    http_address=d.get("http_address", ""),
+                    datacenter=d.get("datacenter", ""))
+
+
+class EtcdDiscovery(Discovery):  # pragma: no cover - requires etcd client
+    """etcd.go › EtcdPool analog: register self under a prefix with a
+    keep-alive lease; watch the prefix.  Requires the ``etcd3`` client
+    library, which is not in this image — constructing this class
+    without it raises with guidance (SURVEY.md §2.1 gating note)."""
+
+    def __init__(self, on_change: OnChange, endpoints: Sequence[str],
+                 prefix: str, self_info: PeerInfo, ttl_s: int = 30):
+        super().__init__(on_change)
+        try:
+            import etcd3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "etcd discovery requires the 'etcd3' package; install it "
+                "or use GUBER_PEER_DISCOVERY_TYPE=dns|file|member-list"
+            ) from e
+        raise NotImplementedError(
+            "etcd3 client found but backend wiring is not implemented in "
+            "this build")
+
+
+class K8sDiscovery(Discovery):  # pragma: no cover - requires kubernetes
+    """kubernetes.go › K8sPool analog: watch Endpoints/Pods via the API
+    server.  Requires the ``kubernetes`` client library (not in this
+    image)."""
+
+    def __init__(self, on_change: OnChange, namespace: str, selector: str,
+                 grpc_port: int):
+        super().__init__(on_change)
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "k8s discovery requires the 'kubernetes' package; install "
+                "it or use GUBER_PEER_DISCOVERY_TYPE=dns (headless "
+                "service) instead") from e
+        raise NotImplementedError(
+            "kubernetes client found but backend wiring is not implemented "
+            "in this build")
+
+
+def make_discovery(cfg: DaemonConfig, self_info: PeerInfo,
+                   on_change: OnChange) -> Optional[Discovery]:
+    """Wire the configured discovery source (daemon.go › SpawnDaemon)."""
+    t = cfg.peer_discovery_type
+    if t in ("none", ""):
+        return None
+    if t == "static":
+        peers = parse_peer_list(cfg.static_peers, cfg.data_center)
+        if self_info.grpc_address not in [p.grpc_address for p in peers]:
+            peers.append(self_info)
+        return StaticDiscovery(on_change, peers)
+    if t == "file":
+        return FileDiscovery(on_change, cfg.peers_file,
+                             default_dc=cfg.data_center)
+    if t == "dns":
+        from .netutil import split_host_port
+
+        _, grpc_port = split_host_port(cfg.grpc_listen_address)
+        return DnsDiscovery(on_change, cfg.dns_fqdn, grpc_port,
+                            cfg.dns_resolve_interval_ms, cfg.data_center)
+    if t in ("member-list", "memberlist", "gossip"):
+        from .netutil import split_host_port
+
+        host, grpc_port = split_host_port(self_info.grpc_address)
+        bind = f"{host}:{grpc_port + 1}"
+        return GossipDiscovery(on_change, bind, self_info,
+                               cfg.memberlist_known_hosts)
+    if t == "etcd":
+        return EtcdDiscovery(on_change, cfg.etcd_endpoints, cfg.etcd_prefix,
+                             self_info)
+    if t == "k8s":
+        from .netutil import split_host_port
+
+        _, grpc_port = split_host_port(cfg.grpc_listen_address)
+        return K8sDiscovery(on_change, cfg.k8s_namespace,
+                            cfg.k8s_pod_selector, grpc_port)
+    raise ValueError(f"unknown peer discovery type: {t!r}")

@@ -1,0 +1,174 @@
+"""GLOBAL behavior: async hit reconciliation + owner broadcasts.
+
+reference: global.go › globalManager{QueueHits, QueueUpdate,
+runAsyncHits, runBroadcasts} — reconstructed, mount empty.
+
+Any peer answers GLOBAL requests immediately from its local replica of
+the counter; hits are queued here and asynchronously flushed to the
+key's owner (aggregated per key); the owner applies them to its
+authoritative copy and periodically broadcasts merged state to every
+peer, which overwrites the replicas.  Short-window over-admission is the
+documented consequence (SURVEY.md §2.4 GLOBAL).
+
+On a TPU pod the intra-node analog of this manager is the psum delta
+fold (SURVEY.md §3.3); this module is the inter-node (host gRPC) tier.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from .config import BehaviorConfig
+from .interval import IntervalLoop
+from .proto import peers_pb2 as peers_pb
+from .types import Algorithm, Behavior, RateLimitRequest
+
+log = logging.getLogger("gubernator_tpu.global")
+
+
+class GlobalManager:
+    def __init__(self, instance, behaviors: BehaviorConfig, metrics):
+        self.instance = instance
+        self.behaviors = behaviors
+        self.metrics = metrics
+        self._mu = threading.Lock()
+        #: key → (request prototype, accumulated hits) — non-owner side.
+        self._hits: Dict[str, Tuple[RateLimitRequest, int]] = {}
+        #: key → request prototype for changed GLOBAL keys — owner side.
+        self._updates: Dict[str, RateLimitRequest] = {}
+        self._err_mu = threading.Lock()
+        self._last_error = ""
+        self._last_error_at = 0.0
+        self._hits_loop = IntervalLoop(
+            behaviors.global_sync_wait_ms, self._run_async_hits,
+            name="global-async-hits")
+        self._bcast_loop = IntervalLoop(
+            behaviors.global_broadcast_interval_ms, self._run_broadcasts,
+            name="global-broadcasts")
+
+    # ---- producers (called from the request path) ----------------------
+
+    def queue_hits(self, req: RateLimitRequest) -> None:
+        """Accumulate hits for async reconcile to the owner.
+        reference: global.go › QueueHits."""
+        with self._mu:
+            proto, acc = self._hits.get(req.key, (req, 0))
+            self._hits[req.key] = (req, acc + max(int(req.hits), 0))
+            n = len(self._hits)
+        self.metrics.queue_length.set(n)
+        if n >= self.behaviors.global_batch_limit:
+            self._hits_loop.poke()
+
+    def queue_update(self, req: RateLimitRequest) -> None:
+        """Mark a GLOBAL key changed on the owner; broadcast on next tick.
+        reference: global.go › QueueUpdate."""
+        with self._mu:
+            self._updates[req.key] = req
+            n = len(self._updates)
+        if n >= self.behaviors.global_batch_limit:
+            self._bcast_loop.poke()
+
+    # ---- async loops ---------------------------------------------------
+
+    def _run_async_hits(self) -> None:
+        """Flush aggregated hits to each key's owner.
+        reference: global.go › runAsyncHits."""
+        with self._mu:
+            hits, self._hits = self._hits, {}
+        self.metrics.queue_length.set(0)
+        if not hits:
+            return
+        # group by owner peer
+        by_owner: Dict[str, Tuple[object, List[RateLimitRequest]]] = {}
+        for key, (req, acc) in hits.items():
+            if acc <= 0:
+                continue
+            peer = self.instance.owner_of(key)
+            if peer is None or self.instance.is_self(peer):
+                continue  # we are the owner: already applied locally
+            merged = RateLimitRequest(
+                name=req.name, unique_key=req.unique_key, hits=acc,
+                limit=req.limit, duration=req.duration,
+                algorithm=req.algorithm, behavior=req.behavior,
+                burst=req.burst)
+            addr = peer.info.grpc_address
+            by_owner.setdefault(addr, (peer, []))[1].append(merged)
+        errors = []
+        for addr, (peer, reqs) in by_owner.items():
+            try:
+                limit = self.behaviors.global_batch_limit
+                for i in range(0, len(reqs), limit):
+                    peer.get_peer_rate_limits(
+                        reqs[i:i + limit],
+                        timeout_s=self.behaviors.global_timeout_ms / 1000.0)
+            except Exception as e:  # noqa: BLE001 - next tick retries fresh
+                errors.append(f"global hits sync to {addr}: {e}")
+                self.metrics.check_error_counter.labels(
+                    error="global_hits_sync").inc()
+                log.warning(errors[-1])
+        self._record(errors)
+
+    def _run_broadcasts(self) -> None:
+        """Owner side: push merged authoritative state to all peers.
+        reference: global.go › runBroadcasts → UpdatePeerGlobals."""
+        with self._mu:
+            updates, self._updates = self._updates, {}
+        if not updates:
+            return
+        t0 = time.perf_counter()
+        msgs = self.instance.build_global_updates(list(updates.values()))
+        if not msgs:
+            return
+        peers = [p for p in self.instance.peers() if not self.instance.is_self(p)]
+        errors = []
+        for peer in peers:
+            try:
+                limit = self.behaviors.global_batch_limit
+                for i in range(0, len(msgs), limit):
+                    peer.update_peer_globals(msgs[i:i + limit])
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"global broadcast to "
+                              f"{peer.info.grpc_address}: {e}")
+                self.metrics.check_error_counter.labels(
+                    error="global_broadcast").inc()
+                log.warning(errors[-1])
+        self._record(errors)
+        self.metrics.global_broadcast_counter.inc()
+        self.metrics.broadcast_duration.observe(time.perf_counter() - t0)
+
+    # ---- error surfacing (health_check) --------------------------------
+
+    #: An async-replication error older than this no longer marks the
+    #: daemon unhealthy (the loops retry every tick; a stale error would
+    #: otherwise fail readiness probes forever).
+    ERROR_TTL_S = 60.0
+
+    def _record(self, errors) -> None:
+        """Per-tick error aggregation: success clears, failure stamps."""
+        with self._err_mu:
+            if errors:
+                self._last_error = "; ".join(errors)
+                self._last_error_at = time.monotonic()
+            else:
+                self._last_error = ""
+
+    @property
+    def last_error(self) -> str:
+        with self._err_mu:
+            if (self._last_error and
+                    time.monotonic() - self._last_error_at > self.ERROR_TTL_S):
+                return ""
+            return self._last_error
+
+    def poke(self) -> None:
+        """Force both loops to run now (tests / shutdown flush)."""
+        self._hits_loop.poke()
+        self._bcast_loop.poke()
+
+    def close(self) -> None:
+        self._hits_loop.close()
+        self._bcast_loop.close()

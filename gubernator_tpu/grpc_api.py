@@ -1,0 +1,89 @@
+"""gRPC service registration and client stubs.
+
+The reference ships protoc-generated gRPC bindings (*.pb.go /
+python pb2_grpc — SURVEY.md §2.1 "Wire protocol"); grpc_tools isn't
+available in this image, so the equivalent wiring is written by hand on
+grpc's generic-handler API.  Wire format and method paths are identical
+to generated code: /pb.gubernator.V1/... and /pb.gubernator.PeersV1/...
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+
+from .proto import gubernator_pb2 as pb
+from .proto import peers_pb2 as peers_pb
+
+V1_SERVICE = "pb.gubernator.V1"
+PEERS_SERVICE = "pb.gubernator.PeersV1"
+
+
+def add_v1_servicer(server: grpc.Server, servicer) -> None:
+    """servicer: object with GetRateLimits(req, ctx) / HealthCheck(req, ctx)
+    taking and returning pb2 messages."""
+    handlers = {
+        "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+            servicer.GetRateLimits,
+            request_deserializer=pb.GetRateLimitsReq.FromString,
+            response_serializer=pb.GetRateLimitsResp.SerializeToString),
+        "HealthCheck": grpc.unary_unary_rpc_method_handler(
+            servicer.HealthCheck,
+            request_deserializer=pb.HealthCheckReq.FromString,
+            response_serializer=pb.HealthCheckResp.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(V1_SERVICE, handlers),))
+
+
+def add_peers_servicer(server: grpc.Server, servicer) -> None:
+    """servicer: object with GetPeerRateLimits / UpdatePeerGlobals."""
+    handlers = {
+        "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPeerRateLimits,
+            request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
+            response_serializer=peers_pb.GetPeerRateLimitsResp.SerializeToString),
+        "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
+            servicer.UpdatePeerGlobals,
+            request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
+            response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(PEERS_SERVICE, handlers),))
+
+
+class V1Stub:
+    """Client stub for the V1 service (generated-code equivalent)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetRateLimits = channel.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=pb.GetRateLimitsReq.SerializeToString,
+            response_deserializer=pb.GetRateLimitsResp.FromString)
+        self.HealthCheck = channel.unary_unary(
+            f"/{V1_SERVICE}/HealthCheck",
+            request_serializer=pb.HealthCheckReq.SerializeToString,
+            response_deserializer=pb.HealthCheckResp.FromString)
+
+
+class PeersV1Stub:
+    """Client stub for the PeersV1 service."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetPeerRateLimits = channel.unary_unary(
+            f"/{PEERS_SERVICE}/GetPeerRateLimits",
+            request_serializer=peers_pb.GetPeerRateLimitsReq.SerializeToString,
+            response_deserializer=peers_pb.GetPeerRateLimitsResp.FromString)
+        self.UpdatePeerGlobals = channel.unary_unary(
+            f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+            request_serializer=peers_pb.UpdatePeerGlobalsReq.SerializeToString,
+            response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString)
+
+
+def dial_peer(address: str, tls_creds: Optional[grpc.ChannelCredentials] = None
+              ) -> grpc.Channel:
+    """Open a channel to a peer (peer_client.go › dialPeer analog)."""
+    opts = [("grpc.enable_retries", 1)]
+    if tls_creds is not None:
+        return grpc.secure_channel(address, tls_creds, options=opts)
+    return grpc.insecure_channel(address, options=opts)

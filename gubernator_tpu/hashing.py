@@ -90,6 +90,19 @@ def hash_keys(keys: Sequence[str]) -> np.ndarray:
     return np.where(x == 0, np.uint64(1), x)
 
 
+def hash_request_keys(names: Sequence[str], unique_keys: Sequence[str]
+                      ) -> np.ndarray:
+    """Batch identity hash of (name, unique_key) pairs, never 0.
+
+    With the native extension this skips building the joined
+    ``name + "_" + key`` strings entirely (the ingest hot path)."""
+    if _native is not None:
+        raw = _native.hash_pairs(names, unique_keys)
+        x = mix64_np(raw)
+        return np.where(x == 0, np.uint64(1), x)
+    return hash_keys([n + "_" + k for n, k in zip(names, unique_keys)])
+
+
 def shard_of(key_hash: np.ndarray | int, num_shards: int) -> np.ndarray | int:
     """Shard index by hash range (top 32 bits), the consistent-hash-range
     analog of hash.go › ConsistantHash.Get.  Stable under fixed

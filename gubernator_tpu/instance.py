@@ -1,0 +1,438 @@
+"""The core instance: request routing over the device engine.
+
+reference: gubernator.go › V1Instance{GetRateLimits, GetPeerRateLimits,
+UpdatePeerGlobals, HealthCheck, SetPeers} — reconstructed, mount empty.
+
+The hot path inverts the reference design (SURVEY.md §7.1): instead of a
+per-request loop over a mutex-guarded LRU, all locally-owned requests in
+a client batch execute as ONE device program (probe → gather →
+branchless update → scatter) on the sharded HBM table.  Peer routing
+(consistent hash over daemon processes) wraps around that device core
+exactly like the reference wraps around its cache.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .config import Config
+from .global_manager import GlobalManager
+from .gregorian import gregorian_rate_duration_ms
+from .hashing import hash_key
+from .metrics import Metrics
+from .multiregion import MultiRegionManager
+from .peer_client import ErrClosing, PeerClient
+from .peers import RegionPeerPicker, ReplicatedConsistentHash
+from .proto import gubernator_pb2 as pb
+from .proto import peers_pb2 as peers_pb
+from .store import CacheItem
+from .types import (
+    Algorithm,
+    Behavior,
+    GregorianDuration,
+    HealthCheckResponse,
+    MAX_BATCH_SIZE,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+    Status,
+)
+
+log = logging.getLogger("gubernator_tpu.instance")
+
+
+def clock_ms() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class V1Instance:
+    """One daemon's rate-limit brain: device engine + peer router."""
+
+    def __init__(self, config: Config, mesh=None, engine=None,
+                 peer_tls_creds=None):
+        from .parallel import ShardedEngine, make_mesh
+
+        self.config = config
+        self.metrics = Metrics()
+        if engine is None:
+            m = mesh if mesh is not None else make_mesh()
+            n = m.shape["shard"]
+            cap_local = max(config.cache_size // n, 1024)
+            cap_local = 1 << (cap_local - 1).bit_length()
+            engine = ShardedEngine(m, capacity_per_shard=cap_local,
+                                   batch_per_shard=config.batch_rows)
+        self.engine = engine
+        self._engine_mu = threading.Lock()
+        self._peer_tls = peer_tls_creds
+        # Datacenter-aware deployments route through a region picker
+        # (region_picker.go); single-region uses the flat ring.
+        if config.data_center:
+            self._picker = RegionPeerPicker(config.data_center)
+        else:
+            self._picker = ReplicatedConsistentHash()
+        self._peer_mu = threading.Lock()
+        self._self_addr = config.advertise_address
+        self.global_manager: Optional[GlobalManager] = None
+        self.mr_manager: Optional[MultiRegionManager] = None
+        self._gm_mu = threading.Lock()
+        self._closed = False
+        self._last_sweep = clock_ms()
+        self.store = config.store
+        self.loader = config.loader
+        if self.loader is not None:
+            self._load_from_loader()
+
+    # ---- persistence wiring (store.go › Loader/Store) ------------------
+
+    def _load_from_loader(self) -> None:
+        from .store import arrays_from_items
+
+        items = list(self.loader.load())
+        if items:
+            arrays = arrays_from_items(items)
+            placed = self.engine.restore(arrays)
+            log.info("loader: restored %d/%d items", placed, len(items))
+
+    def _save_to_loader(self) -> None:
+        from .store import items_from_arrays
+
+        if self.loader is None:
+            return
+        self.loader.save(iter(items_from_arrays(self.engine.snapshot())))
+
+    # ---- peer management (gubernator.go › SetPeers) --------------------
+
+    def set_peers(self, infos: Sequence[PeerInfo]) -> None:
+        """Rebuild the picker atomically; drain clients for departed
+        peers.  Keys silently re-home on ring change; moved keys reset
+        (documented reference behavior, SURVEY.md §5.3)."""
+        with self._peer_mu:
+            old = {p.info.grpc_address: p for p in self._picker.peers()}
+            picker = self._picker.new()
+            for info in infos:
+                existing = old.pop(info.grpc_address, None)
+                if existing is not None:
+                    picker.add(existing)
+                else:
+                    picker.add(PeerClient(info, self.config.behaviors,
+                                          tls_creds=self._peer_tls,
+                                          metrics=self.metrics))
+            self._picker = picker
+        for departed in old.values():
+            threading.Thread(target=departed.shutdown, daemon=True).start()
+
+    def peers(self) -> List[PeerClient]:
+        with self._peer_mu:
+            return self._picker.peers()
+
+    def owner_of(self, key: str) -> Optional[PeerClient]:
+        with self._peer_mu:
+            if not self._picker.peers():
+                return None
+            return self._picker.get(key)
+
+    def is_self(self, peer: PeerClient) -> bool:
+        return peer.info.grpc_address == self._self_addr
+
+    def _ensure_global_manager(self) -> GlobalManager:
+        with self._gm_mu:
+            if self.global_manager is None:
+                self.global_manager = GlobalManager(
+                    self, self.config.behaviors, self.metrics)
+            return self.global_manager
+
+    def _ensure_mr_manager(self) -> MultiRegionManager:
+        with self._gm_mu:
+            if self.mr_manager is None:
+                self.mr_manager = MultiRegionManager(
+                    self, self.config.behaviors)
+            return self.mr_manager
+
+    def region_pickers(self) -> dict:
+        """Per-datacenter pickers (region_picker.go); single-region
+        deployments expose their one ring under their own name."""
+        with self._peer_mu:
+            if isinstance(self._picker, RegionPeerPicker):
+                return dict(self._picker.regions)
+            return {self.config.data_center: self._picker}
+
+    # ---- the public API ------------------------------------------------
+
+    def get_rate_limits(self, reqs: Sequence[RateLimitRequest],
+                        now_ms: Optional[int] = None
+                        ) -> List[RateLimitResponse]:
+        """Batch entry point (gubernator.go › GetRateLimits): split by
+        ownership, serve owned + GLOBAL keys in one device step, forward
+        the rest to their owners (batched per peer)."""
+        if len(reqs) > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"Requests.RateLimits list too large; max size is "
+                f"{MAX_BATCH_SIZE}")
+        now = clock_ms() if now_ms is None else now_ms
+        self.metrics.getratelimit_counter.labels(calltype="api").inc(len(reqs))
+        self.metrics.concurrent_checks.inc()
+        try:
+            with self.metrics.time_func("GetRateLimits"):
+                return self._get_rate_limits(reqs, now)
+        finally:
+            self.metrics.concurrent_checks.dec()
+
+    def _get_rate_limits(self, reqs, now) -> List[RateLimitResponse]:
+        n = len(reqs)
+        responses: List[Optional[RateLimitResponse]] = [None] * n
+        local_idx: List[int] = []
+        fwd: List[tuple[int, PeerClient, RateLimitRequest]] = []
+
+        have_peers = bool(self.peers())
+        for i, req in enumerate(reqs):
+            if not req.unique_key:
+                responses[i] = RateLimitResponse(
+                    error="field 'unique_key' cannot be empty")
+                continue
+            if not req.name:
+                responses[i] = RateLimitResponse(
+                    error="field 'name' cannot be empty")
+                continue
+            if req.behavior & Behavior.GLOBAL:
+                # GLOBAL: answer from the local replica now, reconcile
+                # hits to the owner asynchronously (global.go semantics).
+                local_idx.append(i)
+                gm = self._ensure_global_manager()
+                owner = self.owner_of(req.key) if have_peers else None
+                if owner is not None and not self.is_self(owner):
+                    gm.queue_hits(req)
+                else:
+                    gm.queue_update(req)
+                continue
+            if not have_peers:
+                local_idx.append(i)
+                if req.behavior & Behavior.MULTI_REGION:
+                    self._ensure_mr_manager().queue_hits(req)
+                continue
+            owner = self.owner_of(req.key)
+            if owner is None or self.is_self(owner):
+                local_idx.append(i)
+                # local-region owner replicates cross-DC asynchronously
+                if req.behavior & Behavior.MULTI_REGION:
+                    self._ensure_mr_manager().queue_hits(req)
+            else:
+                fwd.append((i, owner, req))
+
+        # forwards first (async futures), so the device step overlaps RPCs
+        futures: List[tuple[int, Future]] = []
+        for i, peer, req in fwd:
+            if req.behavior & Behavior.NO_BATCHING:
+                f: Future = Future()
+
+                def _go(peer=peer, req=req, f=f):
+                    try:
+                        f.set_result(peer.get_peer_rate_limit(req))
+                    except Exception as e:  # noqa: BLE001
+                        f.set_exception(e)
+
+                threading.Thread(target=_go, daemon=True).start()
+            else:
+                try:
+                    f = peer.enqueue(req)
+                except Exception as e:  # noqa: BLE001 - incl. ErrClosing
+                    f = Future()
+                    f.set_exception(e)
+            futures.append((i, f))
+
+        if local_idx:
+            with self._engine_mu:
+                local_resps = self.engine.check_batch(
+                    [reqs[i] for i in local_idx], now)
+            for i, resp in zip(local_idx, local_resps):
+                responses[i] = resp
+                if resp.status == Status.OVER_LIMIT:
+                    self.metrics.over_limit_counter.inc()
+            self._after_local(
+                [reqs[i] for i in local_idx],
+                [responses[i] for i in local_idx])
+
+        timeout = (self.config.behaviors.batch_timeout_ms
+                   + self.config.behaviors.batch_wait_ms) / 1000.0 + 30.0
+        for i, f in futures:
+            try:
+                responses[i] = f.result(timeout=timeout)
+                if responses[i].status == Status.OVER_LIMIT:
+                    self.metrics.over_limit_counter.inc()
+            except Exception as e:  # noqa: BLE001
+                self.metrics.check_error_counter.labels(
+                    error="peer_forward").inc()
+                responses[i] = RateLimitResponse(
+                    error=f"while fetching rate limit from peer: {e}")
+        self._maybe_sweep(now)
+        return responses  # type: ignore[return-value]
+
+    def _after_local(self, reqs, resps) -> None:
+        """Post-step hooks: Store write-through for mutated keys."""
+        if self.store is None:
+            return
+        for req, resp in zip(reqs, resps):
+            if resp.error:
+                continue
+            self.store.on_change(req, CacheItem(
+                key=req.key, algorithm=int(req.algorithm),
+                limit=resp.limit, duration=int(req.duration),
+                remaining=resp.remaining, expire_at=resp.reset_time,
+                status=int(resp.status)))
+
+    def _maybe_sweep(self, now: int) -> None:
+        iv = self.config.sweep_interval_ms
+        if iv > 0 and now - self._last_sweep >= iv:
+            self._last_sweep = now
+            with self._engine_mu:
+                self.engine.sweep(now)
+
+    # ---- peer service (owner side) -------------------------------------
+
+    def get_peer_rate_limits(self, reqs: Sequence[RateLimitRequest],
+                             now_ms: Optional[int] = None
+                             ) -> List[RateLimitResponse]:
+        """Apply a forwarded batch locally (gubernator.go ›
+        GetPeerRateLimits).  GLOBAL keys get queued for broadcast."""
+        if len(reqs) > self.config.behaviors.batch_limit:
+            raise ValueError(
+                "'PeerRequest.rate_limits' list too large; max size is "
+                f"{self.config.behaviors.batch_limit}")
+        now = clock_ms() if now_ms is None else now_ms
+        self.metrics.getratelimit_counter.labels(calltype="peer").inc(len(reqs))
+        with self._engine_mu:
+            resps = self.engine.check_batch(list(reqs), now)
+        gm = None
+        for req in reqs:
+            if req.behavior & Behavior.GLOBAL:
+                gm = gm or self._ensure_global_manager()
+                gm.queue_update(req)
+            if req.behavior & Behavior.MULTI_REGION:
+                # we are the local-region owner for this forwarded key
+                self._ensure_mr_manager().queue_hits(req)
+        self._after_local(reqs, resps)
+        return resps
+
+    # ---- GLOBAL broadcast plumbing -------------------------------------
+
+    def build_global_updates(self, reqs: Sequence[RateLimitRequest]
+                             ) -> List[peers_pb.UpdatePeerGlobal]:
+        """Owner side: read authoritative rows for changed GLOBAL keys
+        and serialize them for UpdatePeerGlobals."""
+        from .hashing import hash_request_keys
+
+        khash = hash_request_keys([r.name for r in reqs],
+                                  [r.unique_key for r in reqs])
+        with self._engine_mu:
+            found, cols = self.engine.gather_rows(khash)
+        out: List[peers_pb.UpdatePeerGlobal] = []
+        for j, req in enumerate(reqs):
+            if not found[j]:
+                continue
+            meta = int(cols["meta"][j])
+            alg = meta & 1
+            eff = int(cols["eff_ms"][j])
+            rem = int(cols["remaining"][j])
+            if alg == int(Algorithm.LEAKY_BUCKET):
+                rem_out = rem // max(eff, 1)
+                reset = int(cols["t_ms"][j]) + (
+                    eff // max(int(cols["limit"][j]), 1))
+            else:
+                rem_out = rem
+                reset = int(cols["expire_at"][j])
+            out.append(peers_pb.UpdatePeerGlobal(
+                key=req.key,
+                update=pb.RateLimitResp(
+                    status=(meta >> 1) & 1, limit=int(cols["limit"][j]),
+                    remaining=rem_out, reset_time=reset),
+                algorithm=alg, duration=int(cols["duration"][j]),
+                created_at=int(cols["t_ms"][j]),
+                behavior=int(req.behavior), burst=int(cols["burst"][j])))
+        return out
+
+    def update_peer_globals(self, updates: Sequence[peers_pb.UpdatePeerGlobal]
+                            ) -> None:
+        """Replica side: overwrite local rows with the owner's
+        authoritative state (gubernator.go › UpdatePeerGlobals)."""
+        m = len(updates)
+        if m == 0:
+            return
+        khash = np.zeros(m, np.uint64)
+        cols = {
+            "meta": np.zeros(m, np.int32),
+            "limit": np.zeros(m, np.int64),
+            "duration": np.zeros(m, np.int64),
+            "eff_ms": np.ones(m, np.int64),
+            "burst": np.zeros(m, np.int64),
+            "remaining": np.zeros(m, np.int64),
+            "t_ms": np.zeros(m, np.int64),
+            "expire_at": np.zeros(m, np.int64),
+        }
+        for j, g in enumerate(updates):
+            name, _, uniq = g.key.partition("_")
+            khash[j] = np.uint64(hash_key(name, uniq))
+            alg = int(g.algorithm)
+            if g.behavior & Behavior.DURATION_IS_GREGORIAN:
+                try:
+                    eff = gregorian_rate_duration_ms(int(g.duration))
+                except (ValueError, KeyError):
+                    eff = 1
+            else:
+                eff = max(int(g.duration), 1)
+            burst = int(g.burst) if g.burst > 0 else int(g.update.limit)
+            if alg == int(Algorithm.LEAKY_BUCKET):
+                rem = int(g.update.remaining) * eff
+                expire = int(g.created_at) + eff
+            else:
+                rem = int(g.update.remaining)
+                expire = int(g.update.reset_time)
+            cols["meta"][j] = (alg & 1) | ((int(g.update.status) & 1) << 1)
+            cols["limit"][j] = int(g.update.limit)
+            cols["duration"][j] = int(g.duration)
+            cols["eff_ms"][j] = eff
+            cols["burst"][j] = burst
+            cols["remaining"][j] = rem
+            cols["t_ms"][j] = int(g.created_at)
+            cols["expire_at"][j] = expire
+        with self._engine_mu:
+            self.engine.upsert_rows(khash, cols)
+
+    # ---- health / lifecycle --------------------------------------------
+
+    def health_check(self) -> HealthCheckResponse:
+        """reference: gubernator.go › HealthCheck — healthy + peer count,
+        surfacing the last async replication error if any."""
+        msg = ""
+        status = "healthy"
+        if self.global_manager is not None and self.global_manager.last_error:
+            status = "unhealthy"
+            msg = self.global_manager.last_error
+        elif self.mr_manager is not None and self.mr_manager.last_error:
+            status = "unhealthy"
+            msg = self.mr_manager.last_error
+        self.metrics.cache_size.set(int(self.engine_occupancy()))
+        return HealthCheckResponse(status=status, message=msg,
+                                   peer_count=len(self.peers()))
+
+    def engine_occupancy(self) -> int:
+        from .core.table import occupancy
+
+        return int(occupancy(self.engine.state))
+
+    def close(self) -> None:
+        """Flush async managers, snapshot via Loader, drop peers.
+        reference: V1Instance.Close (SURVEY.md §3.5)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.global_manager is not None:
+            self.global_manager.close()
+        if self.mr_manager is not None:
+            self.mr_manager.close()
+        self._save_to_loader()
+        for p in self.peers():
+            p.shutdown()

@@ -1,0 +1,72 @@
+"""Prometheus metrics.
+
+Mirrors the reference's metric surface (gubernator.go › Collector impl,
+lrucache.go gauges, global.go queue/broadcast metrics — reconstructed)
+with the same metric names where sensible, so existing dashboards can be
+pointed at this service (SURVEY.md §5.5).  Each instance gets its own
+CollectorRegistry (multiple daemons per process in the test cluster).
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5)
+
+
+class Metrics:
+    def __init__(self) -> None:
+        r = self.registry = CollectorRegistry()
+        self.getratelimit_counter = Counter(
+            "gubernator_getratelimit", "GetRateLimits calls",
+            ["calltype"], registry=r)
+        self.over_limit_counter = Counter(
+            "gubernator_over_limit", "OVER_LIMIT decisions", registry=r)
+        self.check_error_counter = Counter(
+            "gubernator_check_error", "errors while checking rate limits",
+            ["error"], registry=r)
+        self.func_duration = Histogram(
+            "gubernator_func_duration", "handler durations (s)",
+            ["name"], buckets=_BUCKETS, registry=r)
+        self.batch_send_duration = Histogram(
+            "gubernator_batch_send_duration",
+            "peer batch flush durations (s)", ["peer_addr"],
+            buckets=_BUCKETS, registry=r)
+        self.queue_length = Gauge(
+            "gubernator_global_queue_length",
+            "pending GLOBAL hit aggregations", registry=r)
+        self.broadcast_duration = Histogram(
+            "gubernator_broadcast_duration", "GLOBAL broadcast durations (s)",
+            buckets=_BUCKETS, registry=r)
+        self.global_broadcast_counter = Counter(
+            "gubernator_broadcast", "GLOBAL broadcasts sent", registry=r)
+        self.cache_size = Gauge(
+            "gubernator_cache_size", "live rows in the counter table",
+            registry=r)
+        self.cache_access_count = Counter(
+            "gubernator_cache_access_count", "table lookups",
+            ["type"], registry=r)
+        self.concurrent_checks = Gauge(
+            "gubernator_concurrent_checks_counter",
+            "in-flight GetRateLimits batches", registry=r)
+
+    @contextmanager
+    def time_func(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.func_duration.labels(name=name).observe(
+                time.perf_counter() - t0)
+
+    def render(self) -> bytes:
+        """Text exposition for the /metrics endpoint."""
+        return generate_latest(self.registry)

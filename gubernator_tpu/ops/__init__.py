@@ -1,0 +1,5 @@
+"""Host-side ops: optional native (C++) fast paths.
+
+``from gubernator_tpu.ops import native`` raises ImportError when the
+extension isn't built (``make native``); callers fall back to numpy.
+"""

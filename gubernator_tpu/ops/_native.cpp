@@ -1,0 +1,133 @@
+// Native host ops for gubernator-tpu.
+//
+// The reference is pure Go (SURVEY.md §2.2) so there is no reference
+// native component to mirror; this extension exists because the
+// host-side request-ingest path (string hashing while the device runs
+// the decision step) is the framework's CPU bottleneck, the role Go's
+// compiled hashmap/hash code plays in the reference.
+//
+// Exposed primitives (wrapped by ops/native.py):
+//   fnv1a64_batch([str|bytes, ...]) -> (bytes, n)   raw FNV-1a 64
+//   fnv1a64_pair_batch(names, keys) -> (bytes, n)   hash(name + "_" + key)
+//
+// The avalanche finalizer stays in Python/numpy (hashing.mix64_np) so
+// there is exactly one source of truth for it.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+static const uint64_t FNV_OFFSET = 0xCBF29CE484222325ULL;
+static const uint64_t FNV_PRIME = 0x100000001B3ULL;
+
+static inline uint64_t fnv1a64(const unsigned char* p, Py_ssize_t n,
+                               uint64_t h = FNV_OFFSET) {
+  for (Py_ssize_t i = 0; i < n; i++) {
+    h ^= (uint64_t)p[i];
+    h *= FNV_PRIME;
+  }
+  return h;
+}
+
+// Borrow a UTF-8 view of a str/bytes item.  Returns false on error.
+static inline bool utf8_view(PyObject* obj, const unsigned char** p,
+                             Py_ssize_t* n) {
+  if (PyUnicode_Check(obj)) {
+    const char* s = PyUnicode_AsUTF8AndSize(obj, n);
+    if (s == nullptr) return false;
+    *p = (const unsigned char*)s;
+    return true;
+  }
+  if (PyBytes_Check(obj)) {
+    *p = (const unsigned char*)PyBytes_AS_STRING(obj);
+    *n = PyBytes_GET_SIZE(obj);
+    return true;
+  }
+  PyErr_SetString(PyExc_TypeError, "expected str or bytes");
+  return false;
+}
+
+static PyObject* fnv1a64_batch(PyObject*, PyObject* arg) {
+  PyObject* seq = PySequence_Fast(arg, "expected a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, n * 8);
+  if (out == nullptr) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  uint64_t* dst = (uint64_t*)PyBytes_AS_STRING(out);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    const unsigned char* p;
+    Py_ssize_t len;
+    if (!utf8_view(PySequence_Fast_GET_ITEM(seq, i), &p, &len)) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    dst[i] = fnv1a64(p, len);
+  }
+  Py_DECREF(seq);
+  return Py_BuildValue("(Nn)", out, n);
+}
+
+// hash(name + "_" + unique_key) without building the joined string —
+// the exact key-identity hash of the request path.
+static PyObject* fnv1a64_pair_batch(PyObject*, PyObject* args) {
+  PyObject *names_arg, *keys_arg;
+  if (!PyArg_ParseTuple(args, "OO", &names_arg, &keys_arg)) return nullptr;
+  PyObject* names = PySequence_Fast(names_arg, "expected a sequence");
+  if (names == nullptr) return nullptr;
+  PyObject* keys = PySequence_Fast(keys_arg, "expected a sequence");
+  if (keys == nullptr) {
+    Py_DECREF(names);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(names);
+  if (PySequence_Fast_GET_SIZE(keys) != n) {
+    Py_DECREF(names);
+    Py_DECREF(keys);
+    PyErr_SetString(PyExc_ValueError, "length mismatch");
+    return nullptr;
+  }
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, n * 8);
+  if (out == nullptr) {
+    Py_DECREF(names);
+    Py_DECREF(keys);
+    return nullptr;
+  }
+  uint64_t* dst = (uint64_t*)PyBytes_AS_STRING(out);
+  const unsigned char underscore = '_';
+  for (Py_ssize_t i = 0; i < n; i++) {
+    const unsigned char *pn, *pk;
+    Py_ssize_t ln, lk;
+    if (!utf8_view(PySequence_Fast_GET_ITEM(names, i), &pn, &ln) ||
+        !utf8_view(PySequence_Fast_GET_ITEM(keys, i), &pk, &lk)) {
+      Py_DECREF(names);
+      Py_DECREF(keys);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    uint64_t h = fnv1a64(pn, ln);
+    h = fnv1a64(&underscore, 1, h);
+    dst[i] = fnv1a64(pk, lk, h);
+  }
+  Py_DECREF(names);
+  Py_DECREF(keys);
+  return Py_BuildValue("(Nn)", out, n);
+}
+
+static PyMethodDef methods[] = {
+    {"fnv1a64_batch", fnv1a64_batch, METH_O,
+     "Batch raw FNV-1a64 of str/bytes -> (le64 bytes, n)"},
+    {"fnv1a64_pair_batch", fnv1a64_pair_batch, METH_VARARGS,
+     "Batch FNV-1a64 of name+'_'+key pairs -> (le64 bytes, n)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native",
+                                       "native host ops", -1, methods};
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&moduledef); }

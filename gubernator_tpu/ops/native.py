@@ -1,0 +1,25 @@
+"""Python face of the native extension (raises ImportError if unbuilt).
+
+hashing.py imports this lazily and falls back to pure numpy; both
+return RAW FNV-1a 64 values — the avalanche finalizer is applied by
+hashing.mix64_np either way.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from . import _native  # ImportError here means: run `make native`
+
+
+def hash_keys(keys: Sequence[str]) -> np.ndarray:
+    """Raw FNV-1a64 of each key string → uint64[n]."""
+    buf, n = _native.fnv1a64_batch(keys)
+    return np.frombuffer(buf, dtype="<u8", count=n).copy()
+
+
+def hash_pairs(names: Sequence[str], unique_keys: Sequence[str]) -> np.ndarray:
+    """Raw FNV-1a64 of name + "_" + unique_key without string joins."""
+    buf, n = _native.fnv1a64_pair_batch(names, unique_keys)
+    return np.frombuffer(buf, dtype="<u8", count=n).copy()

@@ -1,0 +1,22 @@
+"""Build the native extension in place:
+
+    python gubernator_tpu/ops/setup_native.py build_ext --inplace
+    (or `make native` from the repo root)
+"""
+import os
+
+from setuptools import Extension, setup
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+setup(
+    name="gubernator-tpu-native",
+    script_args=None,
+    ext_modules=[
+        Extension(
+            "gubernator_tpu.ops._native",
+            sources=[os.path.relpath(os.path.join(HERE, "_native.cpp"))],
+            extra_compile_args=["-O3", "-std=c++17"],
+        )
+    ],
+)

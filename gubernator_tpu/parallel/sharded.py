@@ -25,8 +25,57 @@ from jax import shard_map
 from ..hashing import shard_of
 from ..types import RateLimitRequest, RateLimitResponse, Status
 from ..core.batch import RequestBatch, empty_batch, pack_requests
-from ..core.step import StepOutput, decide_batch_impl
+from ..core.step import StepOutput, decide_batch_impl, _insert, _lookup, _probe_slots
+from ..core.table import TableState
 from .mesh import SHARD_AXIS, make_mesh, shard_table, table_sharding
+
+#: TableState value columns addressable by row programs (all but `key`).
+VALUE_COLS = tuple(f for f in TableState._fields if f != "key")
+
+
+def make_gather_rows(mesh):
+    """jit program: probe-lookup a [n·B] key block per shard, return
+    (found mask, value columns) — the owner-side read for GLOBAL
+    broadcasts (global.go › runBroadcasts collecting changed items)."""
+
+    def _gather(state, keys):
+        slots = _probe_slots(keys, state.key.shape[0])
+        row, _ = _lookup(state.key, slots, keys)
+        found = (keys != 0) & (row >= 0)
+        cols = tuple(
+            getattr(state, f).at[jnp.where(found, row, 0)].get()
+            for f in VALUE_COLS)
+        return found, cols
+
+    return jax.jit(shard_map(
+        _gather, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=P(SHARD_AXIS)))
+
+
+def make_upsert_rows(mesh):
+    """jit program: find-or-insert a [n·B] key block per shard and
+    overwrite the value columns — the replica-side write for GLOBAL
+    broadcasts (gubernator.go › UpdatePeerGlobals → cache.Add analog).
+    Returns (new_state, placed mask)."""
+
+    def _upsert(state, keys, cols):
+        cap = state.key.shape[0]
+        valid = keys != 0
+        slots = _probe_slots(keys, cap)
+        tkey, row, _ = _insert(state.key, slots, keys, valid,
+                               jnp.full(keys.shape, -1, jnp.int32))
+        placed = valid & (row >= 0)
+        wrow = jnp.where(placed, row, cap)
+        new = {"key": tkey}
+        for f, col in zip(VALUE_COLS, cols):
+            new[f] = getattr(state, f).at[wrow].set(col, mode="drop")
+        return TableState(**new), placed
+
+    sharded = shard_map(
+        _upsert, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)))
+    return jax.jit(sharded)
 
 
 def make_sharded_step(mesh):
@@ -74,6 +123,8 @@ class ShardedEngine:
         self.over_count = 0
         self.insert_count = 0
         self.sweep_count = 0
+        self._gather = None  # lazily-built row programs
+        self._upsert = None
 
     def sweep(self, now_ms: int) -> None:
         """Reclaim expired rows on every shard (elementwise on the
@@ -93,10 +144,11 @@ class ShardedEngine:
                     ) -> List[RateLimitResponse]:
         """Route requests to their owner shards, run waves of the sharded
         step until all are served, reassemble in request order."""
-        from ..hashing import hash_keys
+        from ..hashing import hash_request_keys
 
         n = len(reqs)
-        khash = hash_keys([r.key for r in reqs])
+        khash = hash_request_keys([r.name for r in reqs],
+                                  [r.unique_key for r in reqs])
         shard = shard_of(khash, self.n)
         responses: List[RateLimitResponse] = [None] * n  # type: ignore
         pending = list(range(n))
@@ -171,6 +223,67 @@ class ShardedEngine:
             # in original order for sequential parity.
             pending = sorted(rest)
         return responses
+
+    # ---- row-level access (GLOBAL replication + Store hooks) -----------
+
+    def _route_waves(self, khash: np.ndarray):
+        """Yield (indices, block_slots) waves: each wave maps ≤B keys per
+        shard into the [n·B] block layout."""
+        shard = shard_of(khash, self.n)
+        pending = list(range(len(khash)))
+        while pending:
+            fill = [0] * self.n
+            wave, rest, slots = [], [], []
+            for i in pending:
+                s = int(shard[i])
+                if fill[s] < self.B:
+                    slots.append(s * self.B + fill[s])
+                    fill[s] += 1
+                    wave.append(i)
+                else:
+                    rest.append(i)
+            yield wave, slots
+            pending = rest
+
+    def gather_rows(self, khash: np.ndarray) -> tuple[np.ndarray, dict]:
+        """(found mask, value-column dict) for the given key hashes."""
+        if self._gather is None:
+            self._gather = make_gather_rows(self.mesh)
+        m = len(khash)
+        found = np.zeros(m, bool)
+        out = {f: np.zeros(m, np.asarray(getattr(self.state, f)).dtype)
+               for f in VALUE_COLS}
+        for wave, slots in self._route_waves(khash):
+            keys = np.zeros(self.n * self.B, np.uint64)
+            keys[slots] = khash[wave]
+            f, cols = self._gather(
+                self.state, jax.device_put(keys, self._batch_sharding))
+            f = np.asarray(f)
+            found[wave] = f[slots]
+            for name, col in zip(VALUE_COLS, cols):
+                out[name][wave] = np.asarray(col)[slots]
+        return found, out
+
+    def upsert_rows(self, khash: np.ndarray, cols: dict) -> int:
+        """Find-or-insert rows and overwrite their state; returns the
+        number of rows placed (others dropped: shard probe window full)."""
+        if self._upsert is None:
+            self._upsert = make_upsert_rows(self.mesh)
+        placed_total = 0
+        for wave, slots in self._route_waves(khash):
+            keys = np.zeros(self.n * self.B, np.uint64)
+            keys[slots] = khash[wave]
+            block_cols = []
+            for f in VALUE_COLS:
+                dt = np.asarray(cols[f]).dtype
+                blk = np.zeros(self.n * self.B, dt)
+                blk[slots] = cols[f][wave]
+                block_cols.append(jax.device_put(blk, self._batch_sharding))
+            self.state, placed = self._upsert(
+                self.state, jax.device_put(keys, self._batch_sharding),
+                tuple(block_cols))
+            placed_total += int(np.asarray(placed)[slots].sum())
+        return placed_total
 
     # ---- checkpoint/resume (store.py › Loader array fast path) ---------
 

@@ -1,0 +1,14 @@
+"""Generated protobuf modules (protoc --python_out; see Makefile).
+
+protoc emits absolute imports (``import gubernator_pb2``) which don't
+resolve inside a package; alias the module before loading peers_pb2.
+"""
+import sys
+
+from . import gubernator_pb2
+
+sys.modules.setdefault("gubernator_pb2", gubernator_pb2)
+
+from . import peers_pb2  # noqa: E402
+
+__all__ = ["gubernator_pb2", "peers_pb2"]

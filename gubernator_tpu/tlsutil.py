@@ -1,0 +1,145 @@
+"""TLS setup: file-based certs or in-memory self-signed AutoTLS.
+
+reference: tls.go › SetupTLS / TLSConfig — reconstructed, mount empty.
+AutoTLS generates a throwaway CA + server cert (SAN: localhost,
+127.0.0.1, hostname) exactly for the reference's "just encrypt my lab
+cluster" use case; client-auth modes mirror crypto/tls.ClientAuthType.
+"""
+from __future__ import annotations
+
+import datetime
+import os
+import ssl
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .config import TLSSettings
+
+try:
+    import grpc
+except ImportError:  # pragma: no cover - grpc is present in this image
+    grpc = None
+
+
+@dataclass
+class TLSContext:
+    """Materialized TLS state shared by the gRPC and HTTP listeners."""
+
+    settings: TLSSettings
+    ca_pem: bytes = b""
+    cert_pem: bytes = b""
+    key_pem: bytes = b""
+    client_ca_pem: bytes = b""
+
+    def grpc_server_credentials(self):
+        require = self.settings.client_auth in ("require-any", "verify")
+        root = self.client_ca_pem or self.ca_pem
+        return grpc.ssl_server_credentials(
+            [(self.key_pem, self.cert_pem)],
+            root_certificates=root if require else None,
+            require_client_auth=require)
+
+    def grpc_client_credentials(self):
+        """Credentials peers/clients use to dial a TLS daemon.  With
+        client-auth enabled the server cert doubles as the client cert
+        (peers authenticate with their own daemon cert, as AutoTLS
+        deployments of the reference do)."""
+        require = self.settings.client_auth in ("require-any", "verify")
+        return grpc.ssl_channel_credentials(
+            root_certificates=self.ca_pem or None,
+            private_key=self.key_pem if require else None,
+            certificate_chain=self.cert_pem if require else None)
+
+    def http_ssl_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        # ssl.load_cert_chain requires file paths; stage the PEMs in a
+        # temp dir and remove it immediately after loading (the private
+        # key must not outlive this call on disk).
+        with tempfile.TemporaryDirectory(prefix="gubtls-") as d:
+            cert, key = os.path.join(d, "c.pem"), os.path.join(d, "k.pem")
+            with open(cert, "wb") as f:
+                f.write(self.cert_pem)
+            with open(key, "wb") as f:
+                os.fchmod(f.fileno(), 0o600)
+                f.write(self.key_pem)
+            ctx.load_cert_chain(cert, key)
+            if self.settings.client_auth in ("require-any", "verify"):
+                ctx.verify_mode = ssl.CERT_REQUIRED
+                ca = os.path.join(d, "ca.pem")
+                with open(ca, "wb") as f:
+                    f.write(self.client_ca_pem or self.ca_pem)
+                ctx.load_verify_locations(ca)
+        return ctx
+
+
+def setup_tls(settings: Optional[TLSSettings]) -> Optional[TLSContext]:
+    """reference: tls.go › SetupTLS."""
+    if settings is None:
+        return None
+    ctx = TLSContext(settings=settings)
+    if settings.auto_tls and not settings.cert_file:
+        _generate_auto_tls(ctx)
+    else:
+        with open(settings.cert_file, "rb") as f:
+            ctx.cert_pem = f.read()
+        with open(settings.key_file, "rb") as f:
+            ctx.key_pem = f.read()
+        if settings.ca_file:
+            with open(settings.ca_file, "rb") as f:
+                ctx.ca_pem = f.read()
+    if settings.client_auth_ca_file:
+        with open(settings.client_auth_ca_file, "rb") as f:
+            ctx.client_ca_pem = f.read()
+    return ctx
+
+
+def _generate_auto_tls(ctx: TLSContext) -> None:
+    """Self-signed CA + server cert (tls.go AutoTLS analog)."""
+    import socket
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    def make_key():
+        return ec.generate_private_key(ec.SECP256R1())
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    ca_key = make_key()
+    ca_name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                            "gubernator-tpu-auto-ca")])
+    ca_cert = (x509.CertificateBuilder()
+               .subject_name(ca_name).issuer_name(ca_name)
+               .public_key(ca_key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now - datetime.timedelta(minutes=5))
+               .not_valid_after(now + datetime.timedelta(days=365))
+               .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                              critical=True)
+               .sign(ca_key, hashes.SHA256()))
+
+    key = make_key()
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                         "gubernator-tpu")])
+    san = x509.SubjectAlternativeName([
+        x509.DNSName("localhost"),
+        x509.DNSName(socket.gethostname()),
+        x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1")),
+    ])
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(ca_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(san, critical=False)
+            .sign(ca_key, hashes.SHA256()))
+
+    pem = serialization.Encoding.PEM
+    ctx.ca_pem = ca_cert.public_bytes(pem)
+    ctx.cert_pem = cert.public_bytes(pem) + ctx.ca_pem
+    ctx.key_pem = key.private_bytes(
+        pem, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())

@@ -1,0 +1,65 @@
+"""Dataclass ↔ protobuf converters.
+
+The core framework speaks plain Python types (types.py); the gRPC front
+door and peer transport speak the generated pb2 classes (proto/).  These
+converters are the only place the two meet.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .proto import gubernator_pb2 as pb
+from .proto import peers_pb2 as peers_pb
+from .types import (
+    Algorithm,
+    Behavior,
+    HealthCheckResponse,
+    RateLimitRequest,
+    RateLimitResponse,
+    Status,
+)
+
+
+def req_to_pb(r: RateLimitRequest) -> pb.RateLimitReq:
+    m = pb.RateLimitReq(
+        name=r.name, unique_key=r.unique_key, hits=int(r.hits),
+        limit=int(r.limit), duration=int(r.duration),
+        algorithm=int(r.algorithm), behavior=int(r.behavior),
+        burst=int(r.burst))
+    for k, v in r.metadata.items():
+        m.metadata[k] = v
+    return m
+
+
+def req_from_pb(m: pb.RateLimitReq) -> RateLimitRequest:
+    return RateLimitRequest(
+        name=m.name, unique_key=m.unique_key, hits=m.hits, limit=m.limit,
+        duration=m.duration, algorithm=Algorithm(m.algorithm),
+        behavior=Behavior(m.behavior), burst=m.burst,
+        metadata=dict(m.metadata))
+
+
+def resp_to_pb(r: RateLimitResponse) -> pb.RateLimitResp:
+    m = pb.RateLimitResp(
+        status=int(r.status), limit=int(r.limit), remaining=int(r.remaining),
+        reset_time=int(r.reset_time), error=r.error)
+    for k, v in r.metadata.items():
+        m.metadata[k] = v
+    return m
+
+
+def resp_from_pb(m: pb.RateLimitResp) -> RateLimitResponse:
+    return RateLimitResponse(
+        status=Status(m.status), limit=m.limit, remaining=m.remaining,
+        reset_time=m.reset_time, error=m.error, metadata=dict(m.metadata))
+
+
+def reqs_to_pb(reqs: List[RateLimitRequest]) -> pb.GetRateLimitsReq:
+    m = pb.GetRateLimitsReq()
+    m.requests.extend(req_to_pb(r) for r in reqs)
+    return m
+
+
+def health_to_pb(h: HealthCheckResponse) -> pb.HealthCheckResp:
+    return pb.HealthCheckResp(status=h.status, message=h.message,
+                              peer_count=h.peer_count)

@@ -1,0 +1,184 @@
+"""Daemon lifecycle tests: TLS, checkpoint/resume across restart,
+discovery sources (reference: tls_test.go + cluster restart flows)."""
+import json
+import time
+
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.client import Client
+from gubernator_tpu.config import (
+    BehaviorConfig,
+    DaemonConfig,
+    TLSSettings,
+)
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.discovery import (
+    DnsDiscovery,
+    FileDiscovery,
+    GossipDiscovery,
+    StaticDiscovery,
+)
+from gubernator_tpu.netutil import free_port
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.types import PeerInfo, RateLimitRequest, Status
+
+
+def req(name, key, **kw):
+    d = dict(hits=1, limit=5, duration=60_000)
+    d.update(kw)
+    return RateLimitRequest(name=name, unique_key=key, **d)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(n=2)
+
+
+def test_auto_tls_round_trip(mesh):
+    """reference: tls_test.go › AutoTLS server + TLS client."""
+    cfg = DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address="",
+        cache_size=1 << 10,
+        tls=TLSSettings(auto_tls=True))
+    d = spawn_daemon(cfg, mesh=mesh)
+    try:
+        creds = d.tls.grpc_client_credentials()
+        # the cert's SAN covers "localhost"/127.0.0.1
+        with Client(f"localhost:{d.grpc_port}", tls_creds=creds) as c:
+            r = c.check(req("tls_test", "k1", limit=3))
+            assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 2)
+    finally:
+        d.close()
+
+
+def test_tls_client_auth_required(mesh):
+    """Client-auth mode: a client without a cert must be rejected."""
+    import grpc
+
+    cfg = DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address="",
+        cache_size=1 << 10,
+        tls=TLSSettings(auto_tls=True, client_auth="require-any"))
+    d = spawn_daemon(cfg, mesh=mesh)
+    try:
+        good = d.tls.grpc_client_credentials()  # carries the daemon cert
+        with Client(f"localhost:{d.grpc_port}", tls_creds=good,
+                    timeout_s=10) as c:
+            assert c.check(req("tls_auth", "k1")).error == ""
+        bad = grpc.ssl_channel_credentials(
+            root_certificates=d.tls.ca_pem)  # no client cert
+        with pytest.raises(grpc.RpcError):
+            with Client(f"localhost:{d.grpc_port}", tls_creds=bad,
+                        timeout_s=5) as c:
+                c.check(req("tls_auth", "k2"))
+    finally:
+        d.close()
+
+
+def test_restart_with_snapshot_resumes_state(tmp_path, mesh):
+    """Loader wiring: shutdown saves, restart loads — counters survive
+    (store.go › Loader + cluster.go › Restart analog)."""
+    cfgs = [DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address="",
+        cache_size=1 << 10,
+        snapshot_path=str(tmp_path / f"snap{i}.npz"),
+        behaviors=BehaviorConfig(batch_timeout_ms=30))
+        for i in range(2)]
+    c = cluster_mod.start_with(cfgs, mesh=mesh)
+    try:
+        with Client(c.grpc_address(0)) as cl:
+            for _ in range(3):
+                r = cl.check(req("restart_test", "k1", limit=9))
+            assert r.remaining == 6
+        c.restart(0)
+        c.restart(1)
+        with Client(c.grpc_address(0)) as cl:
+            r = cl.check(req("restart_test", "k1", hits=0, limit=9))
+            assert r.remaining == 6, "state lost across restart"
+    finally:
+        c.stop()
+
+
+def test_static_discovery():
+    got = []
+    StaticDiscovery(got.append, [PeerInfo(grpc_address="a:1"),
+                                 PeerInfo(grpc_address="b:1")])
+    assert len(got) == 1 and len(got[0]) == 2
+
+
+def test_file_discovery(tmp_path):
+    p = tmp_path / "peers.txt"
+    p.write_text("# comment\n10.0.0.1:1051\n10.0.0.2:1051;10.0.0.2:1050@dc2\n")
+    got = []
+    fd = FileDiscovery(got.append, str(p), poll_interval_ms=20)
+    try:
+        assert len(got) == 1
+        peers = got[0]
+        assert peers[0].grpc_address == "10.0.0.1:1051"
+        assert peers[1].datacenter == "dc2"
+        # JSON format + change detection
+        time.sleep(0.05)
+        p.write_text(json.dumps(
+            [{"grpc_address": "10.0.0.3:1051"}]))
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(got) >= 2
+        assert got[-1][0].grpc_address == "10.0.0.3:1051"
+    finally:
+        fd.close()
+
+
+def test_dns_discovery():
+    got = []
+    dd = DnsDiscovery(got.append, "localhost", 1051, poll_interval_ms=60_000)
+    try:
+        assert got, "localhost must resolve"
+        assert got[0][0].grpc_address.endswith(":1051")
+    finally:
+        dd.close()
+
+
+def test_gossip_discovery_two_nodes():
+    """memberlist analog: two UDP gossipers find each other and detect
+    departure."""
+    got_a, got_b = [], []
+    pa, pb = free_port(), free_port()
+    a = GossipDiscovery(
+        got_a.append, f"127.0.0.1:{pa}",
+        PeerInfo(grpc_address="127.0.0.1:9001"), [f"127.0.0.1:{pb}"],
+        interval_ms=50, suspect_ms=400)
+    b = GossipDiscovery(
+        got_b.append, f"127.0.0.1:{pb}",
+        PeerInfo(grpc_address="127.0.0.1:9002"), [f"127.0.0.1:{pa}"],
+        interval_ms=50, suspect_ms=400)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if (got_a and len(got_a[-1]) == 2
+                    and got_b and len(got_b[-1]) == 2):
+                break
+            time.sleep(0.05)
+        assert len(got_a[-1]) == 2, "a never saw b"
+        assert len(got_b[-1]) == 2, "b never saw a"
+        # departure: close b; a must drop it after suspect_ms
+        b.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got_a[-1]) != 1:
+            time.sleep(0.05)
+        assert len(got_a[-1]) == 1, "a never dropped departed b"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_unknown_discovery_type():
+    cfg = DaemonConfig(peer_discovery_type="carrier-pigeon")
+    from gubernator_tpu.discovery import make_discovery
+
+    with pytest.raises(ValueError):
+        make_discovery(cfg, PeerInfo(grpc_address="x:1"), lambda p: None)

@@ -1,0 +1,255 @@
+"""Functional tests over a real in-process cluster (reference:
+functional_test.go + cluster/cluster.go — SURVEY.md §4).  Real gRPC over
+loopback, 4 daemons sharing the virtual CPU device mesh."""
+import threading
+import time
+
+import grpc
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.client import Client, HttpClient
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    GregorianDuration,
+    RateLimitRequest,
+    Status,
+)
+
+UNDER, OVER = Status.UNDER_LIMIT, Status.OVER_LIMIT
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = cluster_mod.start(
+        4, mesh=make_mesh(n=4),
+        behaviors=BehaviorConfig(
+            batch_timeout_ms=30, batch_wait_ms=30,
+            global_sync_wait_ms=40, global_broadcast_interval_ms=40,
+            global_timeout_ms=2000))
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    c = Client(cluster.grpc_address(0))
+    yield c
+    c.close()
+
+
+def req(name, key, **kw):
+    d = dict(hits=1, limit=5, duration=60_000)
+    d.update(kw)
+    return RateLimitRequest(name=name, unique_key=key, **d)
+
+
+class TestFunctional:
+    def test_over_the_limit(self, client):
+        """reference: functional_test.go › TestOverTheLimit."""
+        for i, (want_status, want_rem) in enumerate(
+                [(UNDER, 1), (UNDER, 0), (OVER, 0)]):
+            r = client.check(req("test_over_limit", "account:1234", limit=2))
+            assert r.error == ""
+            assert r.status == want_status, i
+            assert r.remaining == want_rem
+            assert r.limit == 2
+
+    def test_token_bucket(self, client):
+        """reference: functional_test.go › TestTokenBucket."""
+        t0 = time.time() * 1000
+        r = client.check(req("test_token", "k1", limit=3, duration=10_000))
+        assert (r.status, r.remaining) == (UNDER, 2)
+        assert t0 + 9_000 <= r.reset_time <= t0 + 11_000
+        r = client.check(req("test_token", "k1", hits=0, limit=3,
+                             duration=10_000))
+        assert (r.status, r.remaining) == (UNDER, 2)  # query doesn't mutate
+
+    def test_token_bucket_gregorian(self, client):
+        """reference: functional_test.go › TestTokenBucketGregorian."""
+        r = client.check(req(
+            "test_greg", "k1", limit=10,
+            duration=int(GregorianDuration.HOURS),
+            behavior=Behavior.DURATION_IS_GREGORIAN))
+        assert (r.status, r.remaining) == (UNDER, 9)
+        now_ms = time.time() * 1000
+        assert r.reset_time > now_ms  # end of current hour is in the future
+        assert r.reset_time <= now_ms + 3_600_000
+
+    def test_leaky_bucket(self, client):
+        """reference: functional_test.go › TestLeakyBucket."""
+        n = "test_leaky"
+        for want_rem in (4, 3, 2):
+            r = client.check(req(n, "k1", algorithm=Algorithm.LEAKY_BUCKET,
+                                 limit=5, duration=600_000))
+            assert (r.status, r.remaining) == (UNDER, want_rem)
+        # burst < limit
+        r = client.check(req(n, "k2", algorithm=Algorithm.LEAKY_BUCKET,
+                             limit=100, burst=2, duration=600_000))
+        assert (r.status, r.remaining) == (UNDER, 1)
+
+    def test_reset_remaining(self, client):
+        """reference: functional_test.go › TestResetRemaining."""
+        n = "test_reset"
+        for _ in range(3):
+            client.check(req(n, "k1", limit=3))
+        r = client.check(req(n, "k1", limit=3))
+        assert r.status == OVER
+        r = client.check(req(n, "k1", limit=3,
+                             behavior=Behavior.RESET_REMAINING))
+        assert (r.status, r.remaining) == (UNDER, 2)
+
+    def test_change_limit(self, client):
+        """reference: functional_test.go › TestChangeLimit."""
+        n = "test_change_limit"
+        r = client.check(req(n, "k1", limit=10))
+        assert r.remaining == 9
+        r = client.check(req(n, "k1", limit=20))
+        assert (r.limit, r.remaining) == (20, 18)
+        r = client.check(req(n, "k1", limit=5))
+        assert (r.limit, r.remaining) == (5, 2)
+
+    def test_drain_over_limit(self, client):
+        """reference: functional_test.go › TestDrainOverLimit
+        (version-dependent flag, implemented)."""
+        n = "test_drain"
+        r = client.check(req(n, "k1", limit=5, hits=3,
+                             behavior=Behavior.DRAIN_OVER_LIMIT))
+        assert (r.status, r.remaining) == (UNDER, 2)
+        r = client.check(req(n, "k1", limit=5, hits=3,
+                             behavior=Behavior.DRAIN_OVER_LIMIT))
+        assert (r.status, r.remaining) == (OVER, 0)  # drained
+
+    def test_requests_forwarded_to_owner(self, cluster, client):
+        """Non-owned keys must be forwarded: state lives on exactly one
+        daemon (gubernator.go › GetRateLimits fan-out)."""
+        # find a key daemon 0 does NOT own
+        inst0 = cluster.instance_at(0)
+        key = None
+        for i in range(100):
+            k = f"fwd_key_{i}"
+            owner = inst0.owner_of(f"test_forward_{k}")
+            if owner is not None and not inst0.is_self(owner):
+                key = k
+                break
+        assert key is not None
+        r = client.check(req("test_forward", key, limit=7))
+        assert (r.status, r.remaining) == (UNDER, 6)
+        # asking the owner daemon directly must see the same counter
+        owner_d = cluster.owner_daemon_of(f"test_forward_{key}")
+        with Client(owner_d.advertise_address) as oc:
+            r = oc.check(req("test_forward", key, limit=7))
+            assert (r.status, r.remaining) == (UNDER, 5)
+
+    def test_no_batching(self, client):
+        r = client.check(req("test_nobatch", "k1", limit=3,
+                             behavior=Behavior.NO_BATCHING))
+        assert (r.status, r.remaining) == (UNDER, 2)
+
+    def test_global_rate_limits(self, cluster, client):
+        """reference: functional_test.go › TestGlobalRateLimits — hits on
+        a non-owner converge to the owner and broadcast back."""
+        name, key = "test_global", "account:77"
+        r = client.check(req(name, key, limit=100, hits=2,
+                             behavior=Behavior.GLOBAL))
+        assert r.status == UNDER
+        owner_d = cluster.owner_daemon_of(f"{name}_{key}")
+
+        def owner_remaining():
+            with Client(owner_d.advertise_address) as oc:
+                rr = oc.check(req(name, key, limit=100, hits=0,
+                                  behavior=Behavior.GLOBAL))
+                return rr.remaining
+
+        # owner applies the async-reconciled hits within the sync window
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if owner_remaining() == 98:
+                break
+            time.sleep(0.05)
+        assert owner_remaining() == 98
+        # and every replica converges via the broadcast
+        deadline = time.time() + 5
+        ok = False
+        while time.time() < deadline and not ok:
+            ok = True
+            for i in range(4):
+                with Client(cluster.grpc_address(i)) as pc:
+                    rr = pc.check(req(name, key, limit=100, hits=0,
+                                      behavior=Behavior.GLOBAL))
+                    if rr.remaining != 98:
+                        ok = False
+            if not ok:
+                time.sleep(0.05)
+        assert ok, "replicas did not converge to owner state"
+
+    def test_health_check(self, cluster, client):
+        """reference: functional_test.go › TestHealthCheck."""
+        h = client.health_check()
+        assert h.status == "healthy"
+        assert h.peer_count == 4
+
+    def test_multiple_async(self, client):
+        """reference: functional_test.go › TestMultipleAsync — concurrent
+        batches don't lose counts."""
+        n = "test_async"
+        errs = []
+
+        def worker(w):
+            try:
+                resps = client.get_rate_limits(
+                    [req(n, f"k{w}_{i}", limit=9) for i in range(20)])
+                assert all(r.error == "" and r.status == UNDER
+                           for r in resps)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        # every key must have exactly one hit recorded
+        resps = client.get_rate_limits(
+            [req(n, f"k{w}_{i}", hits=0, limit=9)
+             for w in range(8) for i in range(20)])
+        assert all(r.remaining == 8 for r in resps)
+
+    def test_batch_too_large(self, client):
+        with pytest.raises(grpc.RpcError) as ei:
+            client.get_rate_limits(
+                [req("test_big", f"k{i}") for i in range(1001)])
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_empty_fields_error(self, client):
+        r = client.check(RateLimitRequest(name="x", unique_key="",
+                                          limit=1, duration=1000))
+        assert "unique_key" in r.error
+        r = client.check(RateLimitRequest(name="", unique_key="x",
+                                          limit=1, duration=1000))
+        assert "name" in r.error
+
+    def test_http_gateway(self, cluster):
+        """grpc-gateway mirror: JSON in/out + health + metrics."""
+        hc = HttpClient(cluster.http_address(0))
+        r = hc.get_rate_limits([req("test_http", "k1", limit=4)])[0]
+        assert (r.status, r.remaining) == (0, 3)
+        h = hc.health_check()
+        assert h.status == "healthy" and h.peer_count == 4
+        import urllib.request
+
+        with urllib.request.urlopen(
+                cluster.http_address(0) + "/metrics", timeout=10) as f:
+            text = f.read().decode()
+        assert "gubernator_getratelimit" in text
+        assert "gubernator_cache_size" in text
+
+    def test_metadata_round_trip(self, client):
+        r = client.check(req("test_meta", "k1", limit=3,
+                             metadata={"client": "abc"}))
+        assert r.error == ""

@@ -1,0 +1,92 @@
+"""MULTI_REGION cross-datacenter replication tests (reference:
+mutliregion.go + region_picker.go behavior — SURVEY.md §2.1/§5.8).
+Two regions × 2 daemons each, all in-process."""
+import time
+
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.client import Client
+from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.netutil import free_port
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.types import Behavior, RateLimitRequest, Status
+
+
+@pytest.fixture(scope="module")
+def regions():
+    behaviors = BehaviorConfig(
+        batch_timeout_ms=30, batch_wait_ms=30,
+        multi_region_sync_wait_ms=50, multi_region_timeout_ms=5000)
+    cfgs = []
+    for i in range(4):
+        cfgs.append(DaemonConfig(
+            grpc_listen_address=f"127.0.0.1:{free_port()}",
+            http_listen_address="",
+            cache_size=1 << 10,
+            data_center="dc-east" if i < 2 else "dc-west",
+            behaviors=behaviors))
+    c = cluster_mod.start_with(cfgs, mesh=make_mesh(n=2))
+    yield c
+    c.stop()
+
+
+def req(key, **kw):
+    d = dict(hits=1, limit=100, duration=60_000,
+             behavior=Behavior.MULTI_REGION)
+    d.update(kw)
+    return RateLimitRequest(name="mr_test", unique_key=key, **d)
+
+
+def _remaining_in_region(cluster, daemon_idx, key):
+    with Client(cluster.grpc_address(daemon_idx)) as c:
+        r = c.check(req(key, hits=0))
+        return r.remaining
+
+
+def test_region_pickers_split(regions):
+    inst = regions.instance_at(0)
+    pickers = inst.region_pickers()
+    assert set(pickers) == {"dc-east", "dc-west"}
+    assert len(pickers["dc-east"].peers()) == 2
+    assert len(pickers["dc-west"].peers()) == 2
+
+
+def test_cross_region_hits_converge(regions):
+    """Hits applied in dc-east must appear in dc-west's counter within
+    the multi-region sync window (eventual consistency)."""
+    key = "account:300"
+    with Client(regions.grpc_address(0)) as c:  # dc-east daemon
+        for _ in range(3):
+            r = c.check(req(key, hits=2))
+            assert r.error == "" and r.status == Status.UNDER_LIMIT
+    # east region sees its own hits immediately
+    east = _remaining_in_region(regions, 0, key)
+    assert east == 94
+    # west region converges asynchronously
+    deadline = time.time() + 5
+    west = None
+    while time.time() < deadline:
+        west = _remaining_in_region(regions, 2, key)
+        if west == 94:
+            break
+        time.sleep(0.05)
+    assert west == 94, f"west never converged (remaining={west})"
+
+
+def test_no_ping_pong(regions):
+    """The replicated copy must strip MULTI_REGION: counters must NOT
+    keep drifting after convergence (double-replication bug guard)."""
+    key = "account:301"
+    with Client(regions.grpc_address(1)) as c:
+        c.check(req(key, hits=5))
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if _remaining_in_region(regions, 2, key) == 95:
+            break
+        time.sleep(0.05)
+    assert _remaining_in_region(regions, 2, key) == 95
+    # let several sync ticks pass; the value must stay put
+    time.sleep(0.5)
+    assert _remaining_in_region(regions, 2, key) == 95
+    assert _remaining_in_region(regions, 0, key) == 95

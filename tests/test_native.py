@@ -1,0 +1,54 @@
+"""Native host-ops extension tests (skipped when not built)."""
+import numpy as np
+import pytest
+
+native = pytest.importorskip("gubernator_tpu.ops.native")
+
+from gubernator_tpu.hashing import (  # noqa: E402
+    fnv1a64,
+    hash_key,
+    hash_keys,
+    hash_request_keys,
+)
+
+
+def test_raw_fnv_matches_python():
+    keys = ["", "a", "load_k42", "πδ∞ unicode", "x" * 10_000]
+    raw = native.hash_keys(keys)
+    for k, h in zip(keys, raw):
+        assert int(h) == fnv1a64(k.encode("utf-8"))
+
+
+def test_pair_hash_equals_joined():
+    names = ["svc", "", "a_b"]
+    uks = ["user:1", "k", ""]
+    assert (native.hash_pairs(names, uks)
+            == native.hash_keys([f"{n}_{u}" for n, u in zip(names, uks)])).all()
+
+
+def test_hash_request_keys_matches_scalar():
+    names = [f"n{i}" for i in range(100)]
+    uks = [f"u{i}" for i in range(100)]
+    batch = hash_request_keys(names, uks)
+    for i in range(100):
+        assert int(batch[i]) == hash_key(names[i], uks[i])
+
+
+def test_hash_keys_native_equals_fallback():
+    import gubernator_tpu.hashing as H
+
+    keys = [f"mixed_{i}" for i in range(1000)]
+    with_native = hash_keys(keys)
+    saved, H._native = H._native, None
+    try:
+        without = hash_keys(keys)
+    finally:
+        H._native = saved
+    assert (with_native == without).all()
+
+
+def test_errors():
+    with pytest.raises(TypeError):
+        native.hash_keys([1, 2, 3])
+    with pytest.raises(ValueError):
+        native.hash_pairs(["a"], ["b", "c"])

@@ -1,0 +1,34 @@
+"""Example: use the device engine directly (no service shell).
+
+For applications embedding the rate limiter in-process, the way the
+reference is embeddable as a Go library.
+Run: python examples/embedded_engine.py
+"""
+import time
+
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.types import Algorithm, RateLimitRequest
+
+
+def main() -> None:
+    engine = ShardedEngine(make_mesh(), capacity_per_shard=1 << 16)
+    now_ms = int(time.time() * 1000)
+
+    reqs = [RateLimitRequest(name="api", unique_key=f"user:{i}", hits=1,
+                             limit=100, duration=60_000,
+                             algorithm=Algorithm.TOKEN_BUCKET)
+            for i in range(1000)]
+    t0 = time.perf_counter()
+    resps = engine.check_batch(reqs, now_ms)
+    print(f"first batch (incl. compile): {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    resps = engine.check_batch(reqs, now_ms + 10)
+    dt = time.perf_counter() - t0
+    over = sum(1 for r in resps if int(r.status) == 1)
+    print(f"1000 decisions in {dt * 1e3:.2f}ms "
+          f"({1000 / dt / 1e6:.2f}M/s), over_limit={over}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Example: start one daemon, check a rate limit, shut down.
+
+The analog of the reference's examples_test.go flow: spawn → client →
+single TOKEN_BUCKET request → assert UNDER_LIMIT.
+Run: python examples/single_daemon.py
+"""
+from gubernator_tpu.client import Client
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.netutil import free_port
+from gubernator_tpu.types import RateLimitRequest, Status
+
+
+def main() -> None:
+    d = spawn_daemon(DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address=f"127.0.0.1:{free_port()}",
+        cache_size=1 << 12))
+    try:
+        with Client(d.advertise_address) as client:
+            resp = client.check(RateLimitRequest(
+                name="requests_per_sec", unique_key="account:1234",
+                hits=1, limit=10, duration=1_000))
+            assert resp.status == Status.UNDER_LIMIT
+            print(f"status={resp.status.name} remaining={resp.remaining} "
+                  f"limit={resp.limit}")
+    finally:
+        d.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from .proto import gubernator_pb2 as pb
 from .proto import peers_pb2 as peers_pb
 from .store import FileLoader
 from .tlsutil import setup_tls
+from .tracing import span
 from .types import Behavior, PeerInfo, RateLimitRequest
 from .wire import health_to_pb, req_from_pb, resp_to_pb
 
@@ -40,14 +41,15 @@ class _V1Servicer:
         self.instance = instance
 
     def GetRateLimits(self, request: pb.GetRateLimitsReq, context):
-        try:
-            reqs = [req_from_pb(m) for m in request.requests]
-            resps = self.instance.get_rate_limits(reqs)
-        except ValueError as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        out = pb.GetRateLimitsResp()
-        out.responses.extend(resp_to_pb(r) for r in resps)
-        return out
+        with span("grpc.GetRateLimits", metrics=self.instance.metrics):
+            try:
+                reqs = [req_from_pb(m) for m in request.requests]
+                resps = self.instance.get_rate_limits(reqs)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            out = pb.GetRateLimitsResp()
+            out.responses.extend(resp_to_pb(r) for r in resps)
+            return out
 
     def HealthCheck(self, request: pb.HealthCheckReq, context):
         return health_to_pb(self.instance.health_check())
@@ -59,19 +61,21 @@ class _PeersServicer:
 
     def GetPeerRateLimits(self, request: peers_pb.GetPeerRateLimitsReq,
                           context):
-        try:
-            reqs = [req_from_pb(m) for m in request.requests]
-            resps = self.instance.get_peer_rate_limits(reqs)
-        except ValueError as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        out = peers_pb.GetPeerRateLimitsResp()
-        out.rate_limits.extend(resp_to_pb(r) for r in resps)
-        return out
+        with span("grpc.GetPeerRateLimits", metrics=self.instance.metrics):
+            try:
+                reqs = [req_from_pb(m) for m in request.requests]
+                resps = self.instance.get_peer_rate_limits(reqs)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            out = peers_pb.GetPeerRateLimitsResp()
+            out.rate_limits.extend(resp_to_pb(r) for r in resps)
+            return out
 
     def UpdatePeerGlobals(self, request: peers_pb.UpdatePeerGlobalsReq,
                           context):
-        self.instance.update_peer_globals(list(request.globals))
-        return peers_pb.UpdatePeerGlobalsResp()
+        with span("grpc.UpdatePeerGlobals", metrics=self.instance.metrics):
+            self.instance.update_peer_globals(list(request.globals))
+            return peers_pb.UpdatePeerGlobalsResp()
 
 
 def _json_to_req(o: dict) -> RateLimitRequest:
@@ -106,9 +110,12 @@ class Daemon:
     """reference: daemon.go › Daemon.  Use spawn_daemon() to construct."""
 
     def __init__(self, cfg: DaemonConfig, mesh=None, engine=None):
+        from .tracing import DeviceProfiler
+
         self.cfg = cfg
         self.tls = setup_tls(cfg.tls)
         self._closed = False
+        self.profiler = DeviceProfiler.from_env()
         self.instance: Optional[V1Instance] = None
         self.discovery = None
         self.http_server: Optional[ThreadingHTTPServer] = None
@@ -256,6 +263,8 @@ class Daemon:
             self.http_server.server_close()
         if self.instance is not None:
             self.instance.close()
+        if self.profiler is not None:
+            self.profiler.stop()
 
 
 def spawn_daemon(cfg: DaemonConfig, mesh=None, engine=None) -> Daemon:

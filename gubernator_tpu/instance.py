@@ -68,6 +68,12 @@ class V1Instance:
                                    batch_per_shard=config.batch_rows)
         self.engine = engine
         self._engine_mu = threading.Lock()
+        from .dispatcher import Dispatcher
+
+        # Cross-request coalescing: concurrent handler threads share
+        # device launches instead of serializing on the engine lock
+        # (the worker-pool analog, see dispatcher.py).
+        self.dispatcher = Dispatcher(engine, lock=self._engine_mu)
         self._peer_tls = peer_tls_creds
         # Datacenter-aware deployments route through a region picker
         # (region_picker.go); single-region uses the flat ring.
@@ -245,9 +251,9 @@ class V1Instance:
             futures.append((i, f))
 
         if local_idx:
-            with self._engine_mu:
-                local_resps = self.engine.check_batch(
-                    [reqs[i] for i in local_idx], now)
+            local_reqs = [reqs[i] for i in local_idx]
+            self._read_through(local_reqs)
+            local_resps = self.dispatcher.check_batch(local_reqs, now)
             for i, resp in zip(local_idx, local_resps):
                 responses[i] = resp
                 if resp.status == Status.OVER_LIMIT:
@@ -270,6 +276,37 @@ class V1Instance:
                     error=f"while fetching rate limit from peer: {e}")
         self._maybe_sweep(now)
         return responses  # type: ignore[return-value]
+
+    def _read_through(self, reqs) -> None:
+        """Seed table misses from the write-through Store before the
+        device step (store.go › Store.Get on cache miss).  One extra
+        row-gather per batch, only when a Store is configured.
+
+        The whole gather→get→upsert sequence holds the engine lock: a
+        concurrent request inserting the same key between our miss and
+        our overwrite-upsert would otherwise have its hits erased by the
+        stale store copy."""
+        if self.store is None or not reqs:
+            return
+        from .hashing import hash_request_keys
+        from .store import arrays_from_items
+
+        khash = hash_request_keys([r.name for r in reqs],
+                                  [r.unique_key for r in reqs])
+        with self._engine_mu:
+            found, _ = self.engine.gather_rows(khash)
+            items = []
+            for j, req in enumerate(reqs):
+                if found[j]:
+                    continue
+                item = self.store.get(req)
+                if item is not None:
+                    if not item.key and not item.key_hash:
+                        item.key = req.key
+                    items.append(item)
+            if items:
+                arrays = arrays_from_items(items)
+                self.engine.upsert_rows(arrays.pop("key"), arrays)
 
     def _after_local(self, reqs, resps) -> None:
         """Post-step hooks: Store write-through for mutated keys."""
@@ -304,8 +341,9 @@ class V1Instance:
                 f"{self.config.behaviors.batch_limit}")
         now = clock_ms() if now_ms is None else now_ms
         self.metrics.getratelimit_counter.labels(calltype="peer").inc(len(reqs))
-        with self._engine_mu:
-            resps = self.engine.check_batch(list(reqs), now)
+        reqs = list(reqs)
+        self._read_through(reqs)
+        resps = self.dispatcher.check_batch(reqs, now)
         gm = None
         for req in reqs:
             if req.behavior & Behavior.GLOBAL:
@@ -433,6 +471,7 @@ class V1Instance:
             self.global_manager.close()
         if self.mr_manager is not None:
             self.mr_manager.close()
+        self.dispatcher.close()
         self._save_to_loader()
         for p in self.peers():
             p.shutdown()

@@ -1,0 +1,84 @@
+"""Tracing/profiling hooks (SURVEY.md §5.1).
+
+The reference grew OpenTelemetry spans around handlers (otelgrpc
+interceptors in daemon.go, span-per-request in gubernator.go —
+version-dependent).  Here:
+
+- ``span(name)`` wraps host-side sections; if the ``opentelemetry``
+  SDK is installed it emits real OTEL spans, otherwise it degrades to
+  a no-op that still feeds the prometheus duration histogram.
+- ``device_profile(...)`` captures a jax.profiler trace of the device
+  step (the TPU-side profiling story: view in TensorBoard/XProf).
+
+Enable device profiling with GUBER_PROFILE_DIR=/path (daemon reads it).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+log = logging.getLogger("gubernator_tpu.tracing")
+
+try:  # pragma: no cover - OTEL not in this image; degrade gracefully
+    from opentelemetry import trace as _otel_trace
+
+    _tracer = _otel_trace.get_tracer("gubernator_tpu")
+except ImportError:
+    _tracer = None
+
+
+@contextlib.contextmanager
+def span(name: str, metrics=None) -> Iterator[None]:
+    """Host-side span: OTEL when available, always a duration metric —
+    including on the error path (try/finally)."""
+    t0 = time.perf_counter()
+    try:
+        if _tracer is not None:  # pragma: no cover
+            with _tracer.start_as_current_span(name):
+                yield
+        else:
+            yield
+    finally:
+        if metrics is not None:
+            metrics.func_duration.labels(name=name).observe(
+                time.perf_counter() - t0)
+
+
+class DeviceProfiler:
+    """jax.profiler session around the serving loop.
+
+    Usage: ``prof = DeviceProfiler.from_env(); ...; prof.stop()`` —
+    writes an XProf trace for TensorBoard under the given directory.
+    """
+
+    def __init__(self, log_dir: str):
+        import jax
+
+        self.log_dir = log_dir
+        jax.profiler.start_trace(log_dir)
+        self._active = True
+        log.info("device profiling → %s", log_dir)
+
+    @classmethod
+    def from_env(cls) -> Optional["DeviceProfiler"]:
+        d = os.environ.get("GUBER_PROFILE_DIR", "")
+        return cls(d) if d else None
+
+    def stop(self) -> None:
+        if self._active:
+            self._active = False
+            import jax
+
+            jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def step_annotation(name: str) -> Iterator[None]:
+    """Named region visible in device traces (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,86 @@
+"""Dispatcher (worker-pool analog) tests: coalescing, correctness under
+concurrency, error propagation."""
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu.dispatcher import Dispatcher
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.types import RateLimitRequest
+
+NOW = 1_763_000_000_000
+
+
+def req(key, **kw):
+    d = dict(hits=1, limit=1000, duration=600_000)
+    d.update(kw)
+    return RateLimitRequest(name="disp", unique_key=key, **d)
+
+
+@pytest.fixture()
+def engine():
+    return ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+
+
+def test_single_caller(engine):
+    d = Dispatcher(engine)
+    try:
+        r = d.check_batch([req("a")], NOW)
+        assert len(r) == 1 and r[0].remaining == 999
+    finally:
+        d.close()
+
+
+def test_concurrent_callers_share_waves_and_conserve(engine):
+    d = Dispatcher(engine)
+    results = []
+    lock = threading.Lock()
+
+    def worker(w):
+        got = []
+        for i in range(10):
+            got.extend(d.check_batch([req("shared"), req(f"own_{w}_{i}")],
+                                     NOW + i))
+        with lock:
+            results.append(got)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every caller got a response for each request
+        assert all(len(g) == 20 for g in results)
+        # the shared key must have exactly 60 hits recorded
+        check = d.check_batch([req("shared", hits=0)], NOW + 100)[0]
+        assert check.remaining == 1000 - 60
+        # waves were actually merged (fewer launches than callers×batches)
+        # — smoke: the dispatcher survived; merging is probabilistic here
+    finally:
+        d.close()
+
+
+def test_error_propagates_to_all_callers(engine):
+    d = Dispatcher(engine)
+
+    def boom(reqs, now):
+        raise RuntimeError("device on fire")
+
+    d.engine = type("E", (), {"check_batch": staticmethod(boom)})()
+    try:
+        with pytest.raises(RuntimeError, match="device on fire"):
+            d.check_batch([req("x")], NOW)
+    finally:
+        d.close()
+
+
+def test_close_rejects_new_and_drains(engine):
+    d = Dispatcher(engine)
+    d.check_batch([req("pre")], NOW)
+    d.close()
+    with pytest.raises(RuntimeError):
+        d.check_batch([req("post")], NOW)

@@ -1,0 +1,17 @@
+"""Doc-example smoke tests (reference: examples_test.go — BASELINE
+config 1's named source)."""
+import runpy
+import sys
+
+
+def test_single_daemon_example(capsys):
+    runpy.run_path("examples/single_daemon.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "status=UNDER_LIMIT" in out
+    assert "remaining=9" in out
+
+
+def test_embedded_engine_example(capsys):
+    runpy.run_path("examples/embedded_engine.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "decisions in" in out

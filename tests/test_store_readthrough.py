@@ -1,0 +1,65 @@
+"""Write-through + read-through Store wiring on the instance
+(reference: store.go › Store{OnChange, Get} around cache ops)."""
+from gubernator_tpu.config import Config
+from gubernator_tpu.instance import V1Instance
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.store import CacheItem, MockStore
+from gubernator_tpu.types import RateLimitRequest, Status
+
+NOW = 1_762_000_000_000
+
+
+def req(key="k1", **kw):
+    d = dict(hits=1, limit=10, duration=60_000)
+    d.update(kw)
+    return RateLimitRequest(name="rt", unique_key=key, **d)
+
+
+def test_write_through_and_read_through():
+    store = MockStore()
+    inst = V1Instance(Config(cache_size=1 << 10, store=store,
+                             sweep_interval_ms=0), mesh=make_mesh(n=2))
+    try:
+        r = inst.get_rate_limits([req()], now_ms=NOW)[0]
+        assert r.remaining == 9
+        # write-through recorded the mutation
+        assert store.called["on_change"] == 1
+        item = store.items["rt_k1"]
+        assert item.remaining == 9 and item.status == int(Status.UNDER_LIMIT)
+        # read-through consulted only on miss: second hit finds the row
+        inst.get_rate_limits([req()], now_ms=NOW + 5)
+        assert store.called["get"] == 1  # only the first (miss) batch
+    finally:
+        inst.close()
+
+
+def test_read_through_seeds_fresh_instance():
+    """A new instance with a populated Store serves from persisted state
+    without a Loader snapshot."""
+    store = MockStore()
+    store.items["rt_k1"] = CacheItem(
+        key="rt_k1", limit=10, duration=60_000, eff_ms=60_000,
+        remaining=3, t_ms=NOW, expire_at=NOW + 60_000)
+    inst = V1Instance(Config(cache_size=1 << 10, store=store,
+                             sweep_interval_ms=0), mesh=make_mesh(n=2))
+    try:
+        r = inst.get_rate_limits([req(hits=0)], now_ms=NOW + 1000)[0]
+        assert r.remaining == 3, "store state not seeded"
+        r = inst.get_rate_limits([req(hits=3)], now_ms=NOW + 1001)[0]
+        assert (int(r.status), r.remaining) == (0, 0)
+    finally:
+        inst.close()
+
+
+def test_expired_store_item_starts_fresh():
+    store = MockStore()
+    store.items["rt_k2"] = CacheItem(
+        key="rt_k2", limit=10, duration=60_000, eff_ms=60_000,
+        remaining=0, t_ms=NOW - 120_000, expire_at=NOW - 60_000)
+    inst = V1Instance(Config(cache_size=1 << 10, store=store,
+                             sweep_interval_ms=0), mesh=make_mesh(n=2))
+    try:
+        r = inst.get_rate_limits([req(key="k2")], now_ms=NOW)[0]
+        assert r.remaining == 9  # expired persisted item → fresh bucket
+    finally:
+        inst.close()

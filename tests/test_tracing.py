@@ -1,0 +1,42 @@
+"""Tracing/profiling hooks (SURVEY.md §5.1 analog)."""
+import glob
+import os
+
+from gubernator_tpu.metrics import Metrics
+from gubernator_tpu.tracing import DeviceProfiler, span, step_annotation
+
+
+def test_span_records_duration_metric():
+    m = Metrics()
+    with span("TestSection", metrics=m):
+        pass
+    rendered = m.render().decode()
+    assert 'gubernator_func_duration_count{name="TestSection"}' in rendered
+
+
+def test_span_noop_without_metrics():
+    with span("nothing"):
+        pass  # must not raise even with no OTEL installed
+
+
+def test_step_annotation_wraps_device_work():
+    import jax.numpy as jnp
+
+    with step_annotation("unit-test-step"):
+        assert int(jnp.arange(4).sum()) == 6
+
+
+def test_device_profiler_writes_trace(tmp_path):
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "prof")
+    prof = DeviceProfiler(d)
+    jnp.arange(128).sum().block_until_ready()
+    prof.stop()
+    files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace files written"
+
+
+def test_from_env_disabled(monkeypatch):
+    monkeypatch.delenv("GUBER_PROFILE_DIR", raising=False)
+    assert DeviceProfiler.from_env() is None

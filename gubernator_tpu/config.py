@@ -105,6 +105,12 @@ class Config:
     store: Optional[object] = None
     #: Seconds between expired-row sweeps (0 disables).
     sweep_interval_ms: int = 30_000
+    #: Replicated hot-set capacity for GLOBAL keys (0 disables the psum
+    #: tier; see parallel/hotset.py).  Active only for pod-local
+    #: deployments (no cross-host peers).
+    hot_set_capacity: int = 1024
+    #: GLOBAL hits on one key before it is promoted to the hot set.
+    hot_promote_threshold: int = 64
     #: Local peer identity (set by the daemon).
     advertise_address: str = ""
 

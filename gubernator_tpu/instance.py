@@ -86,6 +86,13 @@ class V1Instance:
         self.global_manager: Optional[GlobalManager] = None
         self.mr_manager: Optional[MultiRegionManager] = None
         self._gm_mu = threading.Lock()
+        # Replicated hot-set (psum GLOBAL tier, parallel/hotset.py):
+        # lazily built on first promotion; pod-local only.
+        self._hotset = None
+        self._hot_mu = threading.Lock()
+        self._hot_counts: Dict[str, int] = {}
+        self._hot_sync_loop = None
+        self._promote_pending: List[tuple] = []
         self._closed = False
         self._last_sweep = clock_ms()
         self.store = config.store
@@ -109,6 +116,9 @@ class V1Instance:
 
         if self.loader is None:
             return
+        # hot-set rows live outside the sharded table; fold them back in
+        # so the snapshot is complete
+        self._demote_all()
         self.loader.save(iter(items_from_arrays(self.engine.snapshot())))
 
     # ---- peer management (gubernator.go › SetPeers) --------------------
@@ -131,6 +141,11 @@ class V1Instance:
             self._picker = picker
         for departed in old.values():
             threading.Thread(target=departed.shutdown, daemon=True).start()
+        # The hot-set psum tier is pod-local: once real peers exist, hot
+        # keys must go back to daemon-level ownership with their
+        # consumption intact.
+        if len(infos) > 1:
+            self._demote_all()
 
     def peers(self) -> List[PeerClient]:
         with self._peer_mu:
@@ -192,6 +207,7 @@ class V1Instance:
         n = len(reqs)
         responses: List[Optional[RateLimitResponse]] = [None] * n
         local_idx: List[int] = []
+        hot: List[tuple[int, int]] = []  # (request idx, key hash)
         fwd: List[tuple[int, PeerClient, RateLimitRequest]] = []
 
         have_peers = bool(self.peers())
@@ -205,7 +221,12 @@ class V1Instance:
                     error="field 'name' cannot be empty")
                 continue
             if req.behavior & Behavior.GLOBAL:
-                # GLOBAL: answer from the local replica now, reconcile
+                # Pod-local hot keys take the psum tier: replica-local
+                # decision, consumption folded by one collective per
+                # sync tick (parallel/hotset.py) — no queues at all.
+                if not have_peers and self._hot_route(req, hot, i):
+                    continue
+                # Otherwise: answer from the local replica now, reconcile
                 # hits to the owner asynchronously (global.go semantics).
                 local_idx.append(i)
                 gm = self._ensure_global_manager()
@@ -250,6 +271,18 @@ class V1Instance:
                     f.set_exception(e)
             futures.append((i, f))
 
+        if hot:
+            hot_reqs = [reqs[i] for i, _ in hot]
+            hot_resps = self._hotset.check_batch(
+                hot_reqs, [h for _, h in hot], now)
+            for (i, _), resp in zip(hot, hot_resps):
+                responses[i] = resp
+                if resp.status == Status.OVER_LIMIT:
+                    self.metrics.over_limit_counter.inc()
+            # Store write-through covers hot keys too (replica-local
+            # values; the post-sync merge supersedes them next tick)
+            self._after_local(hot_reqs, hot_resps)
+
         if local_idx:
             local_reqs = [reqs[i] for i in local_idx]
             self._read_through(local_reqs)
@@ -261,6 +294,8 @@ class V1Instance:
             self._after_local(
                 [reqs[i] for i in local_idx],
                 [responses[i] for i in local_idx])
+        if self._promote_pending:
+            self._drain_promotions()
 
         timeout = (self.config.behaviors.batch_timeout_ms
                    + self.config.behaviors.batch_wait_ms) / 1000.0 + 30.0
@@ -276,6 +311,100 @@ class V1Instance:
                     error=f"while fetching rate limit from peer: {e}")
         self._maybe_sweep(now)
         return responses  # type: ignore[return-value]
+
+    # ---- hot-set (psum GLOBAL tier) ------------------------------------
+
+    _HOT_EXCLUDED = (Behavior.RESET_REMAINING | Behavior.DRAIN_OVER_LIMIT
+                     | Behavior.DURATION_IS_GREGORIAN | Behavior.MULTI_REGION)
+
+    def _hot_route(self, req: RateLimitRequest, hot, i) -> bool:
+        """Route a GLOBAL request to the replicated hot set if pinned;
+        count toward promotion otherwise.  Returns True when routed."""
+        if (self.config.hot_set_capacity <= 0
+                or int(req.algorithm) != int(Algorithm.TOKEN_BUCKET)
+                or int(req.behavior) & int(self._HOT_EXCLUDED)):
+            return False
+        kh = hash_key(req.name, req.unique_key)
+        hs = self._hotset
+        if hs is not None and hs.is_pinned(kh):
+            if not hs.matches_pinned(kh, req):
+                # config changed: migrate state back and let the
+                # standard path apply the new limit/duration
+                self._demote(kh)
+                return False
+            hot.append((i, kh))
+            return True
+        # promotion bookkeeping (guarded: concurrent handlers must not
+        # double-promote or KeyError on the shared counter dict)
+        with self._hot_mu:
+            c = self._hot_counts.get(req.key, 0) + max(int(req.hits), 1)
+            self._hot_counts[req.key] = c
+            if c >= self.config.hot_promote_threshold:
+                # promote AFTER this batch's device step so the seed
+                # row includes this request's own hits
+                self._promote_pending.append((req, kh))
+                self._hot_counts.pop(req.key, None)
+        return False
+
+    def _drain_promotions(self) -> None:
+        """Pin newly-hot keys, seeding from their sharded-table rows so
+        pre-promotion consumption carries over."""
+        with self._hot_mu:
+            pending, self._promote_pending = self._promote_pending, []
+        for req, kh in pending:
+            hs = self._ensure_hotset()
+            with self._engine_mu:
+                found, cols = self.engine.gather_rows(
+                    np.array([kh], np.uint64))
+            seed = None
+            if found[0]:
+                seed = {f: int(cols[f][0])
+                        for f in ("remaining", "t_ms", "expire_at", "meta")}
+            hs.pin(req, kh, clock_ms(), seed=seed)
+
+    def _demote(self, key_hash: int) -> None:
+        """Migrate one hot key's merged state back into the sharded
+        table, then release its slot — consumption must survive the
+        transition in both directions."""
+        hs = self._hotset
+        if hs is None:
+            return
+        hs.sync()  # fold all replicas so the row read is authoritative
+        row = hs.row_state(key_hash)
+        if row is not None:
+            cols = {f: np.array([row[f]]) for f in row}
+            with self._engine_mu:
+                self.engine.upsert_rows(np.array([key_hash], np.uint64),
+                                        cols)
+        hs.unpin(key_hash)
+
+    def _demote_all(self) -> None:
+        hs = self._hotset
+        if hs is None:
+            return
+        for kh in list(hs.slots.keys()):
+            self._demote(kh)
+
+    def _hot_decay(self) -> None:
+        """Halve promotion counters and drop zeros (runs on the sweep
+        tick): bounds _hot_counts memory and ages out cold keys."""
+        with self._hot_mu:
+            self._hot_counts = {k: v // 2
+                                for k, v in self._hot_counts.items()
+                                if v // 2 > 0}
+
+    def _ensure_hotset(self):
+        with self._gm_mu:
+            if self._hotset is None:
+                from .interval import IntervalLoop
+                from .parallel.hotset import HotSetEngine
+
+                cap = 1 << (self.config.hot_set_capacity - 1).bit_length()
+                self._hotset = HotSetEngine(self.engine.mesh, capacity=cap)
+                self._hot_sync_loop = IntervalLoop(
+                    self.config.behaviors.global_sync_wait_ms,
+                    self._hotset.sync, name="hotset-psum-sync")
+            return self._hotset
 
     def _read_through(self, reqs) -> None:
         """Seed table misses from the write-through Store before the
@@ -327,6 +456,7 @@ class V1Instance:
             self._last_sweep = now
             with self._engine_mu:
                 self.engine.sweep(now)
+            self._hot_decay()
 
     # ---- peer service (owner side) -------------------------------------
 
@@ -471,6 +601,8 @@ class V1Instance:
             self.global_manager.close()
         if self.mr_manager is not None:
             self.mr_manager.close()
+        if self._hot_sync_loop is not None:
+            self._hot_sync_loop.close()
         self.dispatcher.close()
         self._save_to_loader()
         for p in self.peers():

@@ -1,0 +1,297 @@
+"""Replicated hot-set engine: GLOBAL rate limits as one psum per tick.
+
+This is the TPU-native replacement for the reference's entire GLOBAL
+replication machinery (global.go › runAsyncHits + runBroadcasts +
+UpdatePeerGlobals — reconstructed; SURVEY.md §2.3/§3.3): instead of
+non-owners queueing hits over gRPC to an owner which broadcasts merged
+state back, every chip holds a full replica of a small "hot set" table
+and serves GLOBAL decisions locally; consumption deltas are folded
+across the mesh with a single ``lax.psum`` on the sync tick.  Traffic
+per tick is O(hot-set size), independent of request rate — the pod acts
+as one coherent rate-limit region with read-local latency.
+
+Scope (v1, enforced by the host router): TOKEN_BUCKET keys with stable
+(limit, duration) and no RESET/DRAIN/Gregorian flags — the shape of
+real-world hot global limits.  Everything else takes the owner-sharded
+path (parallel/sharded.py), which is already coherent.
+
+Merge semantics (per slot, between syncs; replicas start identical at
+``base``):
+
+- a replica that saw ``now ≥ expire`` re-created the bucket fresh
+  (detected as ``t_i != base.t``); ``any_refresh`` adopts the latest
+  re-creation via pmax of timestamps,
+- per-replica consumption ``d_i = (limit if refreshed_i else base.rem)
+  - rem_i``  (≥ 0),
+- merged ``rem = clamp((limit if any_refresh else base.rem) - Σ d_i,
+  0, limit)``.
+
+Within one sync window total admissions across the mesh can exceed the
+limit by at most (n_chips - 1) × per-window consumption — the same
+eventual-consistency window the reference's GLOBAL behavior documents;
+tests assert convergence and post-sync conservation.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.batch import RequestBatch, empty_batch, pack_requests
+from ..core.step import decide_batch_impl
+from ..core.table import TableState, init_table
+from ..types import RateLimitRequest, RateLimitResponse, Status
+from .mesh import SHARD_AXIS
+
+
+def _rep(mesh):
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def make_hot_step(mesh):
+    """Per-chip replica apply: state has leading [n] device axis; each
+    chip runs the full decision program on its own replica and its own
+    sub-batch.  No collectives on the request path."""
+
+    def _step(state, batch, now):
+        st = jax.tree.map(lambda x: x[0], state)
+        bt = jax.tree.map(lambda x: x[0], batch)
+        st, out = decide_batch_impl(st, bt, now)
+        st = jax.tree.map(lambda x: x[None], st)
+        return st, jax.tree.map(lambda x: x[None],
+                                (out.status, out.remaining, out.reset_time,
+                                 out.limit, out.err))
+
+    return jax.jit(shard_map(
+        _step, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS))))
+
+
+def make_hot_sync(mesh):
+    """The psum fold: merge per-replica consumption into a new common
+    base — the entire global.go subsystem as one collective."""
+    S = SHARD_AXIS
+
+    def _sync(state, base_rem, base_t):
+        st = jax.tree.map(lambda x: x[0], state)
+        brem, bt = base_rem[0], base_t[0]
+        limit = st.limit
+        refreshed = st.t_ms != bt
+        any_refresh = lax.pmax(refreshed.astype(jnp.int32), S) > 0
+        start = jnp.where(refreshed, limit, brem)
+        d = jnp.maximum(start - st.remaining, 0)
+        total = lax.psum(d, S)
+        merged_base = jnp.where(any_refresh, limit, brem)
+        new_rem = jnp.clip(merged_base - total, 0, limit)
+        new_t = lax.pmax(st.t_ms, S)
+        new_exp = lax.pmax(st.expire_at, S)
+        st = st._replace(remaining=new_rem, t_ms=new_t, expire_at=new_exp)
+        out_state = jax.tree.map(lambda x: x[None], st)
+        return out_state, new_rem[None], new_t[None]
+
+    return jax.jit(shard_map(
+        _sync, mesh=mesh,
+        in_specs=(P(S), P(S), P(S)),
+        out_specs=(P(S), P(S), P(S))))
+
+
+class HotSetEngine:
+    """Host-managed replicated hot-set over a mesh.
+
+    The host pins keys to fixed slots (deterministic across replicas —
+    the property open addressing can't give divergent replicas), routes
+    qualifying GLOBAL requests here round-robin across chips, and calls
+    ``sync()`` on the GlobalSyncWait tick.
+    """
+
+    def __init__(self, mesh, capacity: int = 1024, batch_per_chip: int = 512):
+        self.mesh = mesh
+        self.n = mesh.shape[SHARD_AXIS]
+        self.capacity = capacity
+        self.B = batch_per_chip
+        self.slots: Dict[int, int] = {}  # key_hash → slot
+        self.pinned_cfg: Dict[int, tuple] = {}  # key_hash → (limit, duration)
+        self._occupied: set = set()
+        self._mu = threading.Lock()
+        #: Serializes every state read-modify-write (request steps, the
+        #: sync tick, pins): a sync computed from pre-step state would
+        #: otherwise overwrite a concurrent step's consumption.
+        self._state_mu = threading.Lock()
+        # state with leading device axis [n, cap]: one replica per chip
+        base = init_table(capacity)
+        rep = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (self.n,) + x.shape), base)
+        sh = _rep(mesh)
+        self.state: TableState = jax.tree.map(
+            lambda x: jax.device_put(x, sh), rep)
+        self.base_rem = jax.device_put(
+            jnp.zeros((self.n, capacity), jnp.int64), sh)
+        self.base_t = jax.device_put(
+            jnp.zeros((self.n, capacity), jnp.int64), sh)
+        self._step = make_hot_step(mesh)
+        self._sync = make_hot_sync(mesh)
+        self._rr = 0  # round-robin cursor across chips
+        self.sync_count = 0
+
+    # ---- host slot management ------------------------------------------
+
+    def _probe_slots_host(self, key_hash: int) -> List[int]:
+        """The key's probe sequence — MUST match core/step.py ›
+        _probe_slots, since the device kernel looks keys up by probing;
+        a pinned key outside its probe window would be invisible."""
+        from ..core.step import PROBES
+
+        k = np.uint64(key_hash)
+        stride = int((k >> np.uint64(17)) | np.uint64(1))
+        return [int((int(k) + p * stride) & (self.capacity - 1))
+                for p in range(PROBES)]
+
+    def pin(self, req: RateLimitRequest, key_hash: int, now_ms: int,
+            seed: Optional[dict] = None) -> bool:
+        """Assign an on-probe-path slot and initialize the bucket on
+        every replica.  ``seed`` carries the key's current row state
+        from the owner-sharded table (promotion must NOT forget hits
+        already consumed); without it the bucket starts fresh.  Returns
+        False when the key's probe window is fully occupied (hot sets
+        are sized sparse, so this is rare)."""
+        with self._mu:
+            if key_hash in self.slots:
+                return True
+            slot = next((s for s in self._probe_slots_host(key_hash)
+                         if s not in self._occupied), None)
+            if slot is None:
+                return False
+            self._occupied.add(slot)
+            self.slots[key_hash] = slot
+            self.pinned_cfg[key_hash] = (max(int(req.limit), 0),
+                                         max(int(req.duration), 1))
+        limit = max(int(req.limit), 0)
+        dur = max(int(req.duration), 1)
+        host = {
+            "key": np.uint64(key_hash), "meta": np.int32(0),
+            "limit": np.int64(limit), "duration": np.int64(dur),
+            "eff_ms": np.int64(dur), "burst": np.int64(limit),
+            "remaining": np.int64(limit), "t_ms": np.int64(now_ms),
+            "expire_at": np.int64(now_ms + dur),
+        }
+        if seed is not None:
+            for f in ("remaining", "t_ms", "expire_at", "meta"):
+                host[f] = host[f].dtype.type(seed[f])
+        # one tiny device_put per column: pin is rare (promotion only)
+        with self._state_mu:
+            new_cols = {}
+            for f in TableState._fields:
+                col = np.asarray(getattr(self.state, f)).copy()
+                col[:, slot] = host[f]
+                new_cols[f] = jax.device_put(col, _rep(self.mesh))
+            self.state = TableState(**new_cols)
+            br = np.asarray(self.base_rem).copy()
+            br[:, slot] = host["remaining"]
+            self.base_rem = jax.device_put(br, _rep(self.mesh))
+            bt = np.asarray(self.base_t).copy()
+            bt[:, slot] = host["t_ms"]
+            self.base_t = jax.device_put(bt, _rep(self.mesh))
+        return True
+
+    def is_pinned(self, key_hash: int) -> bool:
+        return key_hash in self.slots
+
+    def matches_pinned(self, key_hash: int, req: RateLimitRequest) -> bool:
+        cfg = self.pinned_cfg.get(key_hash)
+        return cfg == (max(int(req.limit), 0), max(int(req.duration), 1))
+
+    def row_state(self, key_hash: int) -> Optional[dict]:
+        """Merged row values for a pinned key (call ``sync()`` first —
+        post-sync all replicas agree; replica 0 is read).  Used to
+        migrate state back to the sharded table on demotion."""
+        slot = self.slots.get(key_hash)
+        if slot is None:
+            return None
+        with self._state_mu:
+            return {f: np.asarray(getattr(self.state, f))[0, slot]
+                    for f in TableState._fields if f != "key"}
+
+    def unpin(self, key_hash: int) -> None:
+        """Release a key's slot and clear its row on every replica."""
+        with self._mu:
+            slot = self.slots.pop(key_hash, None)
+            self.pinned_cfg.pop(key_hash, None)
+            if slot is None:
+                return
+            self._occupied.discard(slot)
+        with self._state_mu:
+            key_col = np.asarray(self.state.key).copy()
+            key_col[:, slot] = 0
+            self.state = self.state._replace(
+                key=jax.device_put(key_col, _rep(self.mesh)))
+
+    def unpin_all(self) -> None:
+        with self._mu:
+            self.slots.clear()
+            self.pinned_cfg.clear()
+            self._occupied.clear()
+
+    # ---- request path ---------------------------------------------------
+
+    def check_batch(self, reqs: Sequence[RateLimitRequest],
+                    key_hashes: Sequence[int], now_ms: int
+                    ) -> List[RateLimitResponse]:
+        """Serve pinned GLOBAL requests: spread across chips round-robin
+        (any replica answers), one device launch, no collectives."""
+        n_req = len(reqs)
+        responses: List[Optional[RateLimitResponse]] = [None] * n_req
+        pending = list(range(n_req))
+        while pending:
+            wave, rest = pending[: self.n * self.B], pending[self.n * self.B:]
+            glob = empty_batch(self.n * self.B)
+            slot_of = []
+            fill = [0] * self.n
+            for i in wave:
+                c = self._rr % self.n
+                self._rr += 1
+                # find a chip with room (wave is bounded so one exists)
+                for _ in range(self.n):
+                    if fill[c] < self.B:
+                        break
+                    c = (c + 1) % self.n
+                pos = c * self.B + fill[c]
+                fill[c] += 1
+                packed, errs = pack_requests([reqs[i]], now_ms, size=1,
+                                             key_hashes=np.array(
+                                                 [key_hashes[i]], np.uint64))
+                for f in range(len(glob)):
+                    np.asarray(glob[f])[pos] = packed[f][0]
+                slot_of.append((i, pos))
+            sh = _rep(self.mesh)
+            dev = RequestBatch(*[
+                jax.device_put(np.asarray(x).reshape(self.n, self.B), sh)
+                for x in glob])
+            with self._state_mu:
+                self.state, outs = self._step(self.state, dev,
+                                              jnp.asarray(now_ms, jnp.int64))
+            status, rem, rst, lim, err = [np.asarray(x).reshape(-1)
+                                          for x in outs]
+            for i, pos in slot_of:
+                responses[i] = RateLimitResponse(
+                    status=Status(int(status[pos])), limit=int(lim[pos]),
+                    remaining=int(rem[pos]), reset_time=int(rst[pos]),
+                    error="hot-set row lost" if err[pos] else "")
+            pending = rest
+        return responses  # type: ignore[return-value]
+
+    # ---- the tick -------------------------------------------------------
+
+    def sync(self) -> None:
+        """Fold all replicas' consumption: ONE psum replaces the
+        reference's hit-queue flush + owner broadcast round-trip."""
+        with self._state_mu:
+            self.state, self.base_rem, self.base_t = self._sync(
+                self.state, self.base_rem, self.base_t)
+        self.sync_count += 1

@@ -1,0 +1,110 @@
+"""Replicated hot-set GLOBAL engine tests (SURVEY.md §2.3 — the psum
+replacement for global.go's hit-queue + broadcast machinery)."""
+import numpy as np
+import pytest
+
+from gubernator_tpu.hashing import hash_key
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.parallel.hotset import HotSetEngine
+from gubernator_tpu.types import RateLimitRequest, Status
+
+NOW = 1_764_000_000_000
+
+
+def req(key="hk", limit=100, hits=1, duration=60_000):
+    return RateLimitRequest(name="hot", unique_key=key, hits=hits,
+                            limit=limit, duration=duration)
+
+
+def kh(key="hk"):
+    return hash_key("hot", key)
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return make_mesh(n=4)
+
+
+def test_pin_and_serve_single_requests(mesh4):
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    assert eng.pin(req(), kh(), NOW)
+    assert eng.pin(req(), kh(), NOW)  # idempotent
+    r = eng.check_batch([req(hits=3)], [kh()], NOW)[0]
+    assert r.error == ""
+    assert (int(r.status), r.remaining) == (0, 97)
+
+
+def test_replicas_diverge_then_psum_converges(mesh4):
+    """Each chip consumes locally; one sync() folds all consumption."""
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    eng.pin(req(limit=1000), kh("c"), NOW)
+    # 40 hits spread round-robin over 4 replicas (10 each)
+    rs = eng.check_batch([req("c", limit=1000) for _ in range(40)],
+                         [kh("c")] * 40, NOW + 1)
+    assert all(r.status == Status.UNDER_LIMIT for r in rs)
+    # before sync, each replica only saw its own 10 hits
+    per_replica_rem = {r.remaining for r in rs}
+    assert min(per_replica_rem) >= 1000 - 40 // eng.n - 1
+    eng.sync()
+    # after sync every replica agrees on the merged count
+    rs = eng.check_batch([req("c", limit=1000, hits=0)
+                          for _ in range(eng.n)], [kh("c")] * eng.n, NOW + 2)
+    assert {r.remaining for r in rs} == {960}
+
+
+def test_conservation_across_syncs(mesh4):
+    """Total admitted ≤ limit once syncs run between windows."""
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    eng.pin(req("cons", limit=50), kh("cons"), NOW)
+    admitted = 0
+    for wave in range(10):
+        rs = eng.check_batch([req("cons", limit=50) for _ in range(10)],
+                             [kh("cons")] * 10, NOW + wave)
+        admitted += sum(1 for r in rs if r.status == Status.UNDER_LIMIT)
+        eng.sync()
+    assert admitted == 50  # exact: sync after every wave removes any window
+    rs = eng.check_batch([req("cons", limit=50, hits=0)], [kh("cons")],
+                         NOW + 100)
+    assert rs[0].remaining == 0
+
+
+def test_bounded_over_admission_within_window(mesh4):
+    """Without syncs, over-admission is bounded by n_chips × limit —
+    the documented GLOBAL eventual-consistency window."""
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=64)
+    eng.pin(req("w", limit=10), kh("w"), NOW)
+    rs = eng.check_batch([req("w", limit=10) for _ in range(200)],
+                         [kh("w")] * 200, NOW + 1)
+    admitted = sum(1 for r in rs if r.status == Status.UNDER_LIMIT)
+    assert 10 <= admitted <= 10 * eng.n
+    eng.sync()
+    rs = eng.check_batch([req("w", limit=10, hits=0)], [kh("w")], NOW + 2)
+    assert rs[0].remaining == 0  # clamped at zero after the fold
+
+
+def test_expiry_refresh_merges(mesh4):
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    eng.pin(req("e", limit=20, duration=1_000), kh("e"), NOW)
+    eng.check_batch([req("e", limit=20, duration=1_000)] * 8,
+                    [kh("e")] * 8, NOW + 1)
+    eng.sync()
+    # past expiry: replicas refresh; merged state adopts the refresh
+    rs = eng.check_batch([req("e", limit=20, duration=1_000)] * 8,
+                         [kh("e")] * 8, NOW + 5_000)
+    assert all(r.status == Status.UNDER_LIMIT for r in rs)
+    eng.sync()
+    rs = eng.check_batch([req("e", limit=20, duration=1_000, hits=0)],
+                         [kh("e")], NOW + 5_001)
+    assert rs[0].remaining == 20 - 8
+
+
+def test_probe_window_exhaustion():
+    mesh = make_mesh(n=2)
+    eng = HotSetEngine(mesh, capacity=8, batch_per_chip=8)
+    pinned = 0
+    for i in range(64):
+        if eng.pin(req(f"x{i}"), kh(f"x{i}"), NOW):
+            pinned += 1
+    assert 0 < pinned <= 8
+    eng.unpin_all()
+    assert eng.pin(req("x0"), kh("x0"), NOW)

@@ -1,0 +1,116 @@
+"""Instance-level hot-set integration: promotion, psum convergence,
+fallback rules."""
+import time
+
+from gubernator_tpu.config import BehaviorConfig, Config
+from gubernator_tpu.instance import V1Instance
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
+
+NOW = 1_765_000_000_000
+
+
+def req(key="h1", hits=1, **kw):
+    d = dict(limit=100_000, duration=600_000, behavior=Behavior.GLOBAL)
+    d.update(kw)
+    return RateLimitRequest(name="hotinst", unique_key=key, hits=hits, **d)
+
+
+def mk_instance(threshold=8):
+    return V1Instance(
+        Config(cache_size=1 << 10, sweep_interval_ms=0,
+               hot_set_capacity=64, hot_promote_threshold=threshold,
+               behaviors=BehaviorConfig(global_sync_wait_ms=25)),
+        mesh=make_mesh(n=4))
+
+
+def test_promotion_and_convergence():
+    inst = mk_instance(threshold=8)
+    try:
+        # below threshold: standard GLOBAL path
+        for _ in range(7):
+            r = inst.get_rate_limits([req()], now_ms=NOW)[0]
+            assert r.error == "" and r.status == Status.UNDER_LIMIT
+        assert inst._hotset is None
+        # crossing the threshold promotes the key
+        inst.get_rate_limits([req()], now_ms=NOW + 1)
+        assert inst._hotset is not None and len(inst._hotset.slots) == 1
+        # hot-path traffic: served by replicas, folded by the sync loop
+        for i in range(20):
+            rs = inst.get_rate_limits([req() for _ in range(10)],
+                                      now_ms=NOW + 2 + i)
+            assert all(r.error == "" for r in rs)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            time.sleep(0.05)
+            if inst._hotset.sync_count > 0:
+                rs = inst.get_rate_limits([req(hits=0)] * 4,
+                                          now_ms=NOW + 100)
+                if len({r.remaining for r in rs}) == 1:
+                    break
+        assert inst._hotset.sync_count > 0
+        rs = inst.get_rate_limits([req(hits=0)] * 8, now_ms=NOW + 101)
+        assert len({r.remaining for r in rs}) == 1, "replicas not converged"
+    finally:
+        inst.close()
+
+
+def test_non_token_and_flagged_requests_bypass_hot_set():
+    inst = mk_instance(threshold=1)
+    try:
+        inst.get_rate_limits(
+            [req(key="leaky", algorithm=Algorithm.LEAKY_BUCKET)], now_ms=NOW)
+        inst.get_rate_limits(
+            [req(key="flg",
+                 behavior=Behavior.GLOBAL | Behavior.RESET_REMAINING)],
+            now_ms=NOW)
+        hs = inst._hotset
+        assert hs is None or len(hs.slots) == 0
+    finally:
+        inst.close()
+
+
+def test_config_change_demotes_preserving_consumption():
+    from gubernator_tpu.hashing import hash_key
+
+    inst = mk_instance(threshold=1)
+    try:
+        kh = hash_key("hotinst", "cfg")
+        inst.get_rate_limits([req(key="cfg", limit=100)], now_ms=NOW)
+        assert inst._hotset.is_pinned(kh)
+        # consume 10 more on the hot path
+        inst.get_rate_limits([req(key="cfg", limit=100) for _ in range(10)],
+                             now_ms=NOW + 1)
+        # limit change → demotion: state migrates back, new limit applies
+        r = inst.get_rate_limits([req(key="cfg", limit=50)], now_ms=NOW + 2)[0]
+        assert not inst._hotset.is_pinned(kh)
+        assert r.limit == 50
+        # 11 consumed at limit 100 → remaining 89; limit 100→50 adjust:
+        # clamp(89 + (50-100), 0, 50) = 39; this request takes 1 → 38
+        assert r.remaining == 38, r
+    finally:
+        inst.close()
+
+
+def test_peers_joining_demotes_hot_keys():
+    from gubernator_tpu.hashing import hash_key
+    from gubernator_tpu.types import PeerInfo
+
+    inst = mk_instance(threshold=1)
+    try:
+        kh = hash_key("hotinst", "join")
+        inst.get_rate_limits([req(key="join")], now_ms=NOW)
+        inst.get_rate_limits([req(key="join") for _ in range(5)],
+                             now_ms=NOW + 1)
+        assert inst._hotset.is_pinned(kh)
+        inst.set_peers([PeerInfo(grpc_address="127.0.0.1:1"),
+                        PeerInfo(grpc_address="127.0.0.1:2")])
+        assert not inst._hotset.is_pinned(kh)
+        # migrated consumption is visible in the sharded table
+        import numpy as np
+
+        found, cols = inst.engine.gather_rows(np.array([kh], np.uint64))
+        assert found[0]
+        assert int(cols["remaining"][0]) == 100_000 - 6
+    finally:
+        inst.close()

@@ -141,10 +141,10 @@ class V1Instance:
             self._picker = picker
         for departed in old.values():
             threading.Thread(target=departed.shutdown, daemon=True).start()
-        # The hot-set psum tier is pod-local: once real peers exist, hot
-        # keys must go back to daemon-level ownership with their
-        # consumption intact.
-        if len(infos) > 1:
+        # The hot-set psum tier is pod-local: once any non-self peer
+        # exists (hot routing turns off), hot keys must go back to
+        # daemon-level ownership with their consumption intact.
+        if any(info.grpc_address != self._self_addr for info in infos):
             self._demote_all()
 
     def peers(self) -> List[PeerClient]:
@@ -208,6 +208,7 @@ class V1Instance:
         responses: List[Optional[RateLimitResponse]] = [None] * n
         local_idx: List[int] = []
         hot: List[tuple[int, int]] = []  # (request idx, key hash)
+        solo = None  # lazily: are we the only daemon (hot tier eligible)?
         fwd: List[tuple[int, PeerClient, RateLimitRequest]] = []
 
         have_peers = bool(self.peers())
@@ -224,7 +225,11 @@ class V1Instance:
                 # Pod-local hot keys take the psum tier: replica-local
                 # decision, consumption folded by one collective per
                 # sync tick (parallel/hotset.py) — no queues at all.
-                if not have_peers and self._hot_route(req, hot, i):
+                # "Pod-local" = no peers other than ourselves.
+                if solo is None:
+                    solo = not have_peers or all(
+                        self.is_self(p) for p in self.peers())
+                if solo and self._hot_route(req, hot, i):
                     continue
                 # Otherwise: answer from the local replica now, reconcile
                 # hits to the owner asynchronously (global.go semantics).
@@ -295,7 +300,7 @@ class V1Instance:
                 [reqs[i] for i in local_idx],
                 [responses[i] for i in local_idx])
         if self._promote_pending:
-            self._drain_promotions()
+            self._drain_promotions(now)
 
         timeout = (self.config.behaviors.batch_timeout_ms
                    + self.config.behaviors.batch_wait_ms) / 1000.0 + 30.0
@@ -320,20 +325,23 @@ class V1Instance:
     def _hot_route(self, req: RateLimitRequest, hot, i) -> bool:
         """Route a GLOBAL request to the replicated hot set if pinned;
         count toward promotion otherwise.  Returns True when routed."""
-        if (self.config.hot_set_capacity <= 0
-                or int(req.algorithm) != int(Algorithm.TOKEN_BUCKET)
-                or int(req.behavior) & int(self._HOT_EXCLUDED)):
+        if self.config.hot_set_capacity <= 0:
             return False
+        qualifies = (int(req.algorithm) == int(Algorithm.TOKEN_BUCKET)
+                     and not int(req.behavior) & int(self._HOT_EXCLUDED))
         kh = hash_key(req.name, req.unique_key)
         hs = self._hotset
         if hs is not None and hs.is_pinned(kh):
-            if not hs.matches_pinned(kh, req):
-                # config changed: migrate state back and let the
-                # standard path apply the new limit/duration
+            if not qualifies or not hs.matches_pinned(kh, req):
+                # config changed or a flagged request (RESET/DRAIN/…)
+                # arrived: migrate hot state back so the standard path
+                # operates on the live values, not the promotion-time row
                 self._demote(kh)
                 return False
             hot.append((i, kh))
             return True
+        if not qualifies:
+            return False
         # promotion bookkeeping (guarded: concurrent handlers must not
         # double-promote or KeyError on the shared counter dict)
         with self._hot_mu:
@@ -344,11 +352,18 @@ class V1Instance:
                 # row includes this request's own hits
                 self._promote_pending.append((req, kh))
                 self._hot_counts.pop(req.key, None)
+            elif len(self._hot_counts) > 100_000:
+                # decay inline too: _maybe_sweep may be disabled, and
+                # the counter dict must stay bounded regardless
+                self._hot_counts = {k: v // 2
+                                    for k, v in self._hot_counts.items()
+                                    if v // 2 > 0}
         return False
 
-    def _drain_promotions(self) -> None:
+    def _drain_promotions(self, now: int) -> None:
         """Pin newly-hot keys, seeding from their sharded-table rows so
-        pre-promotion consumption carries over."""
+        pre-promotion consumption carries over.  ``now`` is the batch's
+        logical time — wall clock would break caller-driven time."""
         with self._hot_mu:
             pending, self._promote_pending = self._promote_pending, []
         for req, kh in pending:
@@ -360,7 +375,7 @@ class V1Instance:
             if found[0]:
                 seed = {f: int(cols[f][0])
                         for f in ("remaining", "t_ms", "expire_at", "meta")}
-            hs.pin(req, kh, clock_ms(), seed=seed)
+            hs.pin(req, kh, now, seed=seed)
 
     def _demote(self, key_hash: int) -> None:
         """Migrate one hot key's merged state back into the sharded
@@ -379,11 +394,26 @@ class V1Instance:
         hs.unpin(key_hash)
 
     def _demote_all(self) -> None:
+        """Demote every hot key: ONE sync collective, one batched
+        writeback (peer-join/shutdown latency must not scale with K
+        collectives)."""
         hs = self._hotset
         if hs is None:
             return
-        for kh in list(hs.slots.keys()):
-            self._demote(kh)
+        khs = list(hs.slots.keys())
+        if not khs:
+            return
+        hs.sync()
+        rows = [(kh, hs.row_state(kh)) for kh in khs]
+        rows = [(kh, r) for kh, r in rows if r is not None]
+        if rows:
+            cols = {f: np.array([r[f] for _, r in rows])
+                    for f in rows[0][1]}
+            with self._engine_mu:
+                self.engine.upsert_rows(
+                    np.array([kh for kh, _ in rows], np.uint64), cols)
+        for kh in khs:
+            hs.unpin(kh)
 
     def _hot_decay(self) -> None:
         """Halve promotion counters and drop zeros (runs on the sweep

@@ -118,6 +118,11 @@ class HotSetEngine:
         self.B = batch_per_chip
         self.slots: Dict[int, int] = {}  # key_hash → slot
         self.pinned_cfg: Dict[int, tuple] = {}  # key_hash → (limit, duration)
+        #: Demoted keys keep their slot reserved (and their device row in
+        #: place): clearing the key column would let an in-flight hot
+        #: request re-insert a phantom fresh bucket, and re-pinning at a
+        #: different probe slot would be shadowed by the stale row.
+        self._retired: Dict[int, int] = {}
         self._occupied: set = set()
         self._mu = threading.Lock()
         #: Serializes every state read-modify-write (request steps, the
@@ -164,11 +169,14 @@ class HotSetEngine:
         with self._mu:
             if key_hash in self.slots:
                 return True
-            slot = next((s for s in self._probe_slots_host(key_hash)
-                         if s not in self._occupied), None)
-            if slot is None:
-                return False
-            self._occupied.add(slot)
+            if key_hash in self._retired:
+                slot = self._retired.pop(key_hash)  # reuse: row is there
+            else:
+                slot = next((s for s in self._probe_slots_host(key_hash)
+                             if s not in self._occupied), None)
+                if slot is None:
+                    return False
+                self._occupied.add(slot)
             self.slots[key_hash] = slot
             self.pinned_cfg[key_hash] = (max(int(req.limit), 0),
                                          max(int(req.duration), 1))
@@ -219,23 +227,22 @@ class HotSetEngine:
                     for f in TableState._fields if f != "key"}
 
     def unpin(self, key_hash: int) -> None:
-        """Release a key's slot and clear its row on every replica."""
+        """Stop hot-routing a key.  The slot stays reserved and the
+        device row stays in place (see ``_retired``); hits from requests
+        already in flight land on the retired row and are lost — a
+        bounded, demotion-only window consistent with GLOBAL's
+        eventual-consistency contract."""
         with self._mu:
             slot = self.slots.pop(key_hash, None)
             self.pinned_cfg.pop(key_hash, None)
-            if slot is None:
-                return
-            self._occupied.discard(slot)
-        with self._state_mu:
-            key_col = np.asarray(self.state.key).copy()
-            key_col[:, slot] = 0
-            self.state = self.state._replace(
-                key=jax.device_put(key_col, _rep(self.mesh)))
+            if slot is not None:
+                self._retired[key_hash] = slot
 
     def unpin_all(self) -> None:
         with self._mu:
             self.slots.clear()
             self.pinned_cfg.clear()
+            self._retired.clear()
             self._occupied.clear()
 
     # ---- request path ---------------------------------------------------
@@ -250,10 +257,14 @@ class HotSetEngine:
         pending = list(range(n_req))
         while pending:
             wave, rest = pending[: self.n * self.B], pending[self.n * self.B:]
-            glob = empty_batch(self.n * self.B)
-            slot_of = []
+            # pack the whole wave once, then place with one fancy index
+            packed, _ = pack_requests(
+                [reqs[i] for i in wave], now_ms, size=len(wave),
+                key_hashes=np.asarray([key_hashes[i] for i in wave],
+                                      np.uint64))
+            positions = np.empty(len(wave), np.int64)
             fill = [0] * self.n
-            for i in wave:
+            for j, i in enumerate(wave):
                 c = self._rr % self.n
                 self._rr += 1
                 # find a chip with room (wave is bounded so one exists)
@@ -261,14 +272,12 @@ class HotSetEngine:
                     if fill[c] < self.B:
                         break
                     c = (c + 1) % self.n
-                pos = c * self.B + fill[c]
+                positions[j] = c * self.B + fill[c]
                 fill[c] += 1
-                packed, errs = pack_requests([reqs[i]], now_ms, size=1,
-                                             key_hashes=np.array(
-                                                 [key_hashes[i]], np.uint64))
-                for f in range(len(glob)):
-                    np.asarray(glob[f])[pos] = packed[f][0]
-                slot_of.append((i, pos))
+            glob = empty_batch(self.n * self.B)
+            for f in range(len(glob)):
+                np.asarray(glob[f])[positions] = packed[f][:len(wave)]
+            slot_of = list(zip(wave, positions.tolist()))
             sh = _rep(self.mesh)
             dev = RequestBatch(*[
                 jax.device_put(np.asarray(x).reshape(self.n, self.B), sh)

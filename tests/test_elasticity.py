@@ -1,0 +1,111 @@
+"""Elasticity: membership churn, failure handling, re-sharding
+(reference: SetPeers → picker rebuild + PeerClient drain; SURVEY.md
+§5.3 — keys silently re-home, moved state resets; §7.3 re-sharding)."""
+import numpy as np
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.client import Client
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.types import RateLimitRequest, Status
+
+
+def req(name, key, **kw):
+    d = dict(hits=1, limit=10, duration=60_000)
+    d.update(kw)
+    return RateLimitRequest(name=name, unique_key=key, **d)
+
+
+def test_snapshot_restores_across_shard_counts():
+    """The snapshot is shard-count independent: a 4-shard table restores
+    into a 2-shard engine (rows re-route by hash range) — the intra-node
+    re-sharding story for topology changes."""
+    now = 1_766_000_000_000
+    e4 = ShardedEngine(make_mesh(n=4), capacity_per_shard=1 << 10,
+                       batch_per_shard=64)
+    reqs = [req("resh", f"k{i}", hits=3, limit=9) for i in range(50)]
+    e4.check_batch(reqs, now)
+    snap = e4.snapshot()
+
+    e2 = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 11,
+                       batch_per_shard=64)
+    assert e2.restore(snap) == 50
+    out = e2.check_batch([req("resh", f"k{i}", hits=0, limit=9)
+                          for i in range(50)], now + 5)
+    assert all(r.remaining == 6 for r in out)
+
+    e1 = ShardedEngine(make_mesh(n=1), capacity_per_shard=1 << 12,
+                       batch_per_shard=64)
+    assert e1.restore(snap) == 50
+    out = e1.check_batch([req("resh", f"k{i}", hits=6, limit=9)
+                          for i in range(50)], now + 10)
+    assert all((int(r.status), r.remaining) == (0, 0) for r in out)
+
+
+@pytest.fixture(scope="module")
+def churn_cluster():
+    c = cluster_mod.start(3, mesh=make_mesh(n=2),
+                          behaviors=BehaviorConfig(batch_timeout_ms=30))
+    yield c
+    c.stop()
+
+
+def test_daemon_departure_keys_rehome(churn_cluster):
+    """Stop one daemon; the survivors re-pick owners and keep serving.
+    State owned by the departed daemon resets (documented reference
+    behavior) — but service availability is uninterrupted."""
+    c = churn_cluster
+    with Client(c.grpc_address(0)) as cl:
+        rs = cl.get_rate_limits([req("churn", f"k{i}") for i in range(30)])
+        assert all(r.error == "" for r in rs)
+
+    # daemon 2 leaves: remaining daemons get the shrunk peer list
+    departed = c.daemons[2]
+    survivors = [c.daemons[0], c.daemons[1]]
+    infos = [d.peer_info() for d in survivors]
+    for d in survivors:
+        d.set_peers(infos)
+    departed.close()
+
+    with Client(c.grpc_address(0)) as cl:
+        rs = cl.get_rate_limits([req("churn", f"k{i}") for i in range(30)])
+        assert all(r.error == "" for r in rs), [r.error for r in rs if r.error]
+        # every key is served; re-homed ones restart at limit-1, others
+        # continue at limit-2
+        assert {r.remaining for r in rs} <= {8, 9}
+    h = survivors[0].instance.health_check()
+    assert h.peer_count == 2
+
+    # bring a replacement back on the departed daemon's addresses
+    c.daemons[2] = cluster_mod.spawn_daemon(
+        departed.cfg, mesh=survivors[0].instance.engine.mesh)
+    infos = [d.peer_info() for d in c.daemons]
+    for d in c.daemons:
+        d.set_peers(infos)
+    with Client(c.grpc_address(2)) as cl:
+        rs = cl.get_rate_limits([req("churn", f"k{i}") for i in range(30)])
+        assert all(r.error == "" for r in rs)
+
+
+def test_forward_error_surfaces_per_request(churn_cluster):
+    """A dead peer in the ring must surface as a per-request error, not
+    an exception (gubernator.go wraps peer failures in resp.Error)."""
+    c = churn_cluster
+    inst = c.instance_at(0)
+    from gubernator_tpu.types import PeerInfo
+
+    live = [d.peer_info() for d in c.daemons]
+    dead = PeerInfo(grpc_address="127.0.0.1:1")  # nothing listens here
+    inst.set_peers(live + [dead])
+    try:
+        # find keys owned by the dead peer
+        victims = [k for k in (f"dead{i}" for i in range(200))
+                   if inst.owner_of(f"churn_{k}") is not None
+                   and inst.owner_of(f"churn_{k}").info.grpc_address
+                   == "127.0.0.1:1"][:3]
+        assert victims, "no keys landed on the dead peer"
+        rs = inst.get_rate_limits([req("churn", k) for k in victims])
+        assert all("peer" in r.error for r in rs)
+    finally:
+        inst.set_peers(live)

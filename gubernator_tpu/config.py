@@ -173,6 +173,7 @@ class DaemonConfig:
     etcd_prefix: str = "/gubernator/peers/"
     k8s_namespace: str = ""
     k8s_pod_selector: str = ""
+    k8s_service: str = ""
     memberlist_known_hosts: List[str] = field(default_factory=list)
 
     #: Path for Loader snapshots ("" disables checkpoint/resume).
@@ -297,6 +298,7 @@ def setup_daemon_config(conf_file: str = "",
     d.etcd_prefix = src.get("GUBER_ETCD_PREFIX", d.etcd_prefix)
     d.k8s_namespace = src.get("GUBER_K8S_NAMESPACE", d.k8s_namespace)
     d.k8s_pod_selector = src.get("GUBER_K8S_POD_SELECTOR", d.k8s_pod_selector)
+    d.k8s_service = src.get("GUBER_K8S_SERVICE", d.k8s_service)
     ml = src.get("GUBER_MEMBERLIST_KNOWN_HOSTS", "")
     if ml:
         d.memberlist_known_hosts = [p.strip() for p in ml.split(",") if p.strip()]

@@ -6,11 +6,12 @@ Each source resolves the current peer set and fires ``on_change`` with a
 full []PeerInfo whenever it differs from the last one; the daemon wires
 the callback to V1Instance.set_peers (SURVEY.md §3.4).
 
-Implemented natively here: static, file-watch, and DNS polling.  The
-etcd/Kubernetes pools require their client libraries (not in this image)
-and degrade to a clear error; memberlist-style gossip is provided by
-``GossipDiscovery`` — a small UDP full-mesh heartbeat protocol, the
-in-tree analog of hashicorp/memberlist for lab clusters.
+All sources are implemented natively, with no client-library
+dependencies: static lists, file-watch, DNS polling, UDP gossip
+(``GossipDiscovery``, the in-tree analog of hashicorp/memberlist),
+etcd via its v3 JSON/REST gateway (``EtcdDiscovery``: lease +
+keep-alive + range polling), and Kubernetes via the raw API server
+(``K8sDiscovery``: service-account token + Endpoints/Pods polling).
 """
 from __future__ import annotations
 
@@ -243,45 +244,219 @@ def _peer_info(d: dict) -> PeerInfo:
                     datacenter=d.get("datacenter", ""))
 
 
-class EtcdDiscovery(Discovery):  # pragma: no cover - requires etcd client
-    """etcd.go › EtcdPool analog: register self under a prefix with a
-    keep-alive lease; watch the prefix.  Requires the ``etcd3`` client
-    library, which is not in this image — constructing this class
-    without it raises with guidance (SURVEY.md §2.1 gating note)."""
+class EtcdDiscovery(Discovery):
+    """etcd.go › EtcdPool analog over the etcd v3 JSON/REST gateway —
+    no client library needed.  Registers self under ``prefix`` with a
+    TTL lease, keep-alives the lease every ttl/3, and polls the prefix
+    range for the peer set (polling stands in for the reference's watch
+    stream; interval = ttl/3 keeps membership within one TTL)."""
 
     def __init__(self, on_change: OnChange, endpoints: Sequence[str],
                  prefix: str, self_info: PeerInfo, ttl_s: int = 30):
+        import base64
+
         super().__init__(on_change)
+        if not endpoints:
+            raise ValueError("etcd discovery needs GUBER_ETCD_ENDPOINTS")
+        self._b64 = lambda b: base64.b64encode(b).decode()
+        self._unb64 = base64.b64decode
+        self.endpoints = [e if e.startswith("http") else f"http://{e}"
+                          for e in endpoints]
+        self.prefix = prefix
+        self.self_info = self_info
+        self.ttl_s = ttl_s
+        self.lease_id: Optional[str] = None
+        self._register()
+        self._poll()
+        period = max(ttl_s * 1000 // 3, 1000)
+        self._keep = IntervalLoop(period, self._keepalive, name="etcd-lease")
+        self._loop = IntervalLoop(period, self._poll, name="etcd-poll")
+
+    # -- tiny JSON-over-HTTP client (gateway: POST /v3/<rpc>) -----------
+
+    def _call(self, rpc: str, body: dict) -> dict:
+        import json as _json
+        import urllib.request
+
+        last: Exception = RuntimeError("no etcd endpoints")
+        for ep in self.endpoints:
+            try:
+                req = urllib.request.Request(
+                    f"{ep}/v3/{rpc}", data=_json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as f:
+                    return _json.loads(f.read() or b"{}")
+            except Exception as e:  # noqa: BLE001 - try next endpoint
+                last = e
+        raise last
+
+    def _self_key(self) -> bytes:
+        return (self.prefix + self.self_info.grpc_address).encode()
+
+    def _register(self) -> None:
+        lease = self._call("lease/grant", {"TTL": str(self.ttl_s)})
+        self.lease_id = lease["ID"]
+        self._call("kv/put", {
+            "key": self._b64(self._self_key()),
+            "value": self._b64(json.dumps(
+                _peer_dict(self.self_info)).encode()),
+            "lease": self.lease_id,
+        })
+
+    def _keepalive(self) -> None:
         try:
-            import etcd3  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "etcd discovery requires the 'etcd3' package; install it "
-                "or use GUBER_PEER_DISCOVERY_TYPE=dns|file|member-list"
-            ) from e
-        raise NotImplementedError(
-            "etcd3 client found but backend wiring is not implemented in "
-            "this build")
+            resp = self._call("lease/keepalive", {"ID": self.lease_id})
+            # the gateway answers an EXPIRED lease with HTTP 200 and
+            # TTL<=0/absent — that's a failure, not a success
+            ttl = int((resp.get("result") or {}).get("TTL") or 0)
+            if ttl > 0:
+                return
+            log.warning("etcd lease %s expired; re-registering",
+                        self.lease_id)
+        except Exception as e:  # noqa: BLE001 - re-register below
+            log.warning("etcd keepalive: %s; re-registering", e)
+        try:
+            self._register()
+        except Exception as e2:  # noqa: BLE001
+            log.warning("etcd re-register failed: %s", e2)
+
+    @staticmethod
+    def _range_end(start: bytes) -> bytes:
+        """etcd prefix range end: increment the last byte, carrying over
+        0xff bytes; all-0xff or empty prefix scans to the end of the
+        keyspace (etcd convention: range_end = b"\\x00")."""
+        end = bytearray(start)
+        while end:
+            if end[-1] < 0xFF:
+                end[-1] += 1
+                return bytes(end)
+            end.pop()
+        return b"\x00"
+
+    def _poll(self) -> None:
+        start = self.prefix.encode()
+        try:
+            resp = self._call("kv/range", {
+                "key": self._b64(start),
+                "range_end": self._b64(self._range_end(start))})
+        except Exception as e:  # noqa: BLE001 - keep last membership
+            log.warning("etcd range: %s", e)
+            return
+        peers = []
+        for kv in resp.get("kvs", []):
+            try:
+                peers.append(_peer_info(
+                    json.loads(self._unb64(kv["value"]))))
+            except (ValueError, KeyError):
+                continue
+        if peers:
+            self._notify(sorted(peers, key=lambda p: p.grpc_address))
+
+    def close(self) -> None:
+        self._keep.close()
+        self._loop.close()
+        try:
+            self._call("kv/deleterange",
+                       {"key": self._b64(self._self_key())})
+        except Exception:  # noqa: BLE001 - lease expiry cleans up
+            pass
 
 
-class K8sDiscovery(Discovery):  # pragma: no cover - requires kubernetes
-    """kubernetes.go › K8sPool analog: watch Endpoints/Pods via the API
-    server.  Requires the ``kubernetes`` client library (not in this
-    image)."""
+class K8sDiscovery(Discovery):
+    """kubernetes.go › K8sPool analog over the raw API server (no
+    client library): reads the in-cluster service-account token + CA,
+    polls Endpoints (by service name) or Pods (by label selector) and
+    maps addresses to peers at ``grpc_port``."""
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
     def __init__(self, on_change: OnChange, namespace: str, selector: str,
-                 grpc_port: int):
+                 grpc_port: int, service: str = "", api_base: str = "",
+                 token: str = "", ca_file: str = "",
+                 poll_interval_ms: int = 15_000):
         super().__init__(on_change)
+        self.grpc_port = grpc_port
+        self.namespace = namespace or self._read(f"{self.SA_DIR}/namespace",
+                                                 "default")
+        self.selector = selector
+        self.service = service
+        if not selector and not service:
+            raise ValueError(
+                "k8s discovery needs GUBER_K8S_POD_SELECTOR or "
+                "GUBER_K8S_SERVICE — listing every Endpoints object in "
+                "the namespace would pull foreign services into the ring")
+        if not api_base:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "k8s discovery: not in a cluster (no "
+                    "KUBERNETES_SERVICE_HOST) and no api_base given; use "
+                    "GUBER_PEER_DISCOVERY_TYPE=dns with a headless "
+                    "service instead")
+            api_base = f"https://{host}:{port}"
+        self.api_base = api_base
+        self.token = token or self._read(f"{self.SA_DIR}/token", "")
+        self.ca_file = ca_file or (
+            f"{self.SA_DIR}/ca.crt"
+            if os.path.exists(f"{self.SA_DIR}/ca.crt") else "")
+        self._poll()
+        self._loop = IntervalLoop(poll_interval_ms, self._poll,
+                                  name="k8s-discovery")
+
+    @staticmethod
+    def _read(path: str, default: str) -> str:
         try:
-            import kubernetes  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "k8s discovery requires the 'kubernetes' package; install "
-                "it or use GUBER_PEER_DISCOVERY_TYPE=dns (headless "
-                "service) instead") from e
-        raise NotImplementedError(
-            "kubernetes client found but backend wiring is not implemented "
-            "in this build")
+            with open(path) as f:
+                return f.read().strip()
+        except OSError:
+            return default
+
+    def _get(self, path: str) -> dict:
+        import ssl as _ssl
+        import urllib.request
+
+        ctx = _ssl.create_default_context(
+            cafile=self.ca_file or None)
+        if not self.ca_file:
+            ctx.check_hostname = False
+            ctx.verify_mode = _ssl.CERT_NONE
+        req = urllib.request.Request(self.api_base + path)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as f:
+            return json.loads(f.read())
+
+    def _poll(self) -> None:
+        from urllib.parse import quote
+
+        try:
+            if self.selector:
+                obj = self._get(
+                    f"/api/v1/namespaces/{self.namespace}/pods"
+                    f"?labelSelector={quote(self.selector)}")
+                ips = sorted({
+                    item["status"]["podIP"]
+                    for item in obj.get("items", [])
+                    if item.get("status", {}).get("podIP")
+                    and item["status"].get("phase") == "Running"})
+            else:
+                obj = self._get(
+                    f"/api/v1/namespaces/{self.namespace}/endpoints/"
+                    f"{quote(self.service)}")
+                ips = sorted({
+                    addr["ip"]
+                    for subset in obj.get("subsets", []) or []
+                    for addr in subset.get("addresses", []) or []})
+        except Exception as e:  # noqa: BLE001 - keep last membership
+            log.warning("k8s discovery poll: %s", e)
+            return
+        if ips:
+            self._notify([PeerInfo(grpc_address=f"{ip}:{self.grpc_port}")
+                          for ip in ips])
+
+    def close(self) -> None:
+        self._loop.close()
 
 
 def make_discovery(cfg: DaemonConfig, self_info: PeerInfo,
@@ -319,5 +494,6 @@ def make_discovery(cfg: DaemonConfig, self_info: PeerInfo,
 
         _, grpc_port = split_host_port(cfg.grpc_listen_address)
         return K8sDiscovery(on_change, cfg.k8s_namespace,
-                            cfg.k8s_pod_selector, grpc_port)
+                            cfg.k8s_pod_selector, grpc_port,
+                            service=cfg.k8s_service)
     raise ValueError(f"unknown peer discovery type: {t!r}")

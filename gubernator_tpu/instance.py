@@ -355,9 +355,7 @@ class V1Instance:
             elif len(self._hot_counts) > 100_000:
                 # decay inline too: _maybe_sweep may be disabled, and
                 # the counter dict must stay bounded regardless
-                self._hot_counts = {k: v // 2
-                                    for k, v in self._hot_counts.items()
-                                    if v // 2 > 0}
+                self._decay_counts_locked()
         return False
 
     def _drain_promotions(self, now: int) -> None:
@@ -415,13 +413,17 @@ class V1Instance:
         for kh in khs:
             hs.unpin(kh)
 
+    def _decay_counts_locked(self) -> None:
+        """Halve promotion counters, drop zeros.  Caller holds _hot_mu."""
+        self._hot_counts = {k: v // 2
+                            for k, v in self._hot_counts.items()
+                            if v // 2 > 0}
+
     def _hot_decay(self) -> None:
-        """Halve promotion counters and drop zeros (runs on the sweep
-        tick): bounds _hot_counts memory and ages out cold keys."""
+        """Counter decay on the sweep tick: bounds _hot_counts memory
+        and ages out cold keys."""
         with self._hot_mu:
-            self._hot_counts = {k: v // 2
-                                for k, v in self._hot_counts.items()
-                                if v // 2 > 0}
+            self._decay_counts_locked()
 
     def _ensure_hotset(self):
         with self._gm_mu:

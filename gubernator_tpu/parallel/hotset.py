@@ -172,11 +172,23 @@ class HotSetEngine:
             if key_hash in self._retired:
                 slot = self._retired.pop(key_hash)  # reuse: row is there
             else:
-                slot = next((s for s in self._probe_slots_host(key_hash)
+                probes = self._probe_slots_host(key_hash)
+                slot = next((s for s in probes
                              if s not in self._occupied), None)
                 if slot is None:
-                    return False
-                self._occupied.add(slot)
+                    # reclaim a retired slot in the window: its old key
+                    # was demoted (state already migrated out), so the
+                    # stale row may be overwritten.  Without this,
+                    # promote/demote churn would exhaust capacity.
+                    retired_by_slot = {s: k for k, s in
+                                       self._retired.items()}
+                    slot = next((s for s in probes
+                                 if s in retired_by_slot), None)
+                    if slot is None:
+                        return False
+                    del self._retired[retired_by_slot[slot]]
+                else:
+                    self._occupied.add(slot)
             self.slots[key_hash] = slot
             self.pinned_cfg[key_hash] = (max(int(req.limit), 0),
                                          max(int(req.duration), 1))

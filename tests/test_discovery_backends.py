@@ -1,0 +1,245 @@
+"""etcd / k8s discovery backends against in-process fake API servers
+(reference: etcd.go › EtcdPool, kubernetes.go › K8sPool)."""
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from gubernator_tpu.discovery import EtcdDiscovery, K8sDiscovery
+from gubernator_tpu.netutil import free_port
+from gubernator_tpu.types import PeerInfo
+
+
+class FakeEtcd:
+    """Minimal etcd v3 JSON gateway: lease/grant, lease/keepalive,
+    kv/put, kv/range, kv/deleterange."""
+
+    def __init__(self):
+        self.kv = {}  # bytes key → bytes value
+        self.leases = {}
+        self.next_lease = 100
+        self.keepalives = 0
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                out = fake.handle(self.path, body)
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", free_port()), H)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def handle(self, path, body):
+        if path == "/v3/lease/grant":
+            lid = str(self.next_lease)
+            self.next_lease += 1
+            self.leases[lid] = True
+            return {"ID": lid, "TTL": body["TTL"]}
+        if path == "/v3/lease/keepalive":
+            self.keepalives += 1
+            alive = self.leases.get(body["ID"], False)
+            # etcd convention: expired lease → 200 with TTL 0/absent
+            return {"result": {"ID": body["ID"],
+                               "TTL": "30" if alive else "0"}}
+        if path == "/v3/kv/put":
+            self.kv[base64.b64decode(body["key"])] = base64.b64decode(
+                body["value"])
+            return {}
+        if path == "/v3/kv/range":
+            start = base64.b64decode(body["key"])
+            end = base64.b64decode(body["range_end"])
+            kvs = [{"key": base64.b64encode(k).decode(),
+                    "value": base64.b64encode(v).decode()}
+                   for k, v in sorted(self.kv.items())
+                   if start <= k < end]
+            return {"kvs": kvs, "count": str(len(kvs))}
+        if path == "/v3/kv/deleterange":
+            self.kv.pop(base64.b64decode(body["key"]), None)
+            return {}
+        return {}
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_etcd_register_poll_and_departure():
+    fake = FakeEtcd()
+    got_a, got_b = [], []
+    try:
+        a = EtcdDiscovery(got_a.append, [fake.url], "/gub/peers/",
+                          PeerInfo(grpc_address="10.0.0.1:1051"), ttl_s=3)
+        b = EtcdDiscovery(got_b.append, [fake.url], "/gub/peers/",
+                          PeerInfo(grpc_address="10.0.0.2:1051"), ttl_s=3)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if (got_a and len(got_a[-1]) == 2
+                    and got_b and len(got_b[-1]) == 2):
+                break
+            time.sleep(0.1)
+        assert len(got_a[-1]) == 2 and len(got_b[-1]) == 2
+        assert {p.grpc_address for p in got_a[-1]} == {
+            "10.0.0.1:1051", "10.0.0.2:1051"}
+        # departure: b closes and deletes its key; a sees 1 peer
+        b.close()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(got_a[-1]) != 1:
+            time.sleep(0.1)
+        assert len(got_a[-1]) == 1
+        assert fake.keepalives >= 0
+        a.close()
+        assert not fake.kv, "close() must deregister"
+    finally:
+        fake.close()
+
+
+def test_etcd_endpoint_failover():
+    fake = FakeEtcd()
+    got = []
+    try:
+        d = EtcdDiscovery(got.append,
+                          ["127.0.0.1:1", fake.url],  # first is dead
+                          "/gub/peers/",
+                          PeerInfo(grpc_address="10.0.0.9:1051"), ttl_s=3)
+        assert got and got[-1][0].grpc_address == "10.0.0.9:1051"
+        d.close()
+    finally:
+        fake.close()
+
+
+def test_etcd_requires_endpoints():
+    with pytest.raises(ValueError):
+        EtcdDiscovery(lambda p: None, [], "/p/",
+                      PeerInfo(grpc_address="x:1"))
+
+
+def test_etcd_expired_lease_reregisters():
+    """A lost lease answers keepalive with TTL=0 (HTTP 200) — the pool
+    must detect it and re-register."""
+    fake = FakeEtcd()
+    got = []
+    try:
+        d = EtcdDiscovery(got.append, [fake.url], "/gub/peers/",
+                          PeerInfo(grpc_address="10.0.0.3:1051"), ttl_s=3)
+        # simulate server-side lease expiry + key loss
+        fake.leases.clear()
+        fake.kv.clear()
+        d._keepalive()
+        assert fake.kv, "expired lease did not trigger re-registration"
+        assert d.lease_id in fake.leases
+        d.close()
+    finally:
+        fake.close()
+
+
+def test_etcd_range_end_edge_cases():
+    assert EtcdDiscovery._range_end(b"/gub/") == b"/gub0"
+    assert EtcdDiscovery._range_end(b"a\xff") == b"b"
+    assert EtcdDiscovery._range_end(b"\xff\xff") == b"\x00"
+    assert EtcdDiscovery._range_end(b"") == b"\x00"
+
+
+class FakeK8s:
+    """Minimal API server: /api/v1/namespaces/{ns}/pods and /endpoints."""
+
+    def __init__(self, pods=None, endpoints=None):
+        fake = self
+        self.pods = pods or []
+        self.endpoints = endpoints or []
+        self.auth_seen = []
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                fake.auth_seen.append(self.headers.get("Authorization", ""))
+                fake.paths = getattr(fake, "paths", [])
+                fake.paths.append(self.path)
+                if "/pods" in self.path:
+                    out = {"items": fake.pods}
+                else:
+                    # named-endpoints GET returns ONE Endpoints object
+                    out = fake.endpoints
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", free_port()), H)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_k8s_pod_selector():
+    fake = FakeK8s(pods=[
+        {"status": {"podIP": "10.1.0.5", "phase": "Running"}},
+        {"status": {"podIP": "10.1.0.6", "phase": "Running"}},
+        {"status": {"podIP": "10.1.0.7", "phase": "Pending"}},
+        {"status": {"phase": "Running"}},
+    ])
+    got = []
+    try:
+        d = K8sDiscovery(got.append, "default", "app in (gub,gub2)", 1051,
+                         api_base=fake.url, token="tok-123",
+                         poll_interval_ms=60_000)
+        assert got
+        assert [p.grpc_address for p in got[-1]] == [
+            "10.1.0.5:1051", "10.1.0.6:1051"]
+        assert fake.auth_seen[-1] == "Bearer tok-123"
+        # set-based selectors must be percent-encoded in the URL
+        assert "labelSelector=app%20in%20%28gub%2Cgub2%29" in fake.paths[-1]
+        d.close()
+    finally:
+        fake.close()
+
+
+def test_k8s_named_endpoints_mode():
+    fake = FakeK8s(endpoints={
+        "subsets": [{"addresses": [{"ip": "10.2.0.1"},
+                                   {"ip": "10.2.0.2"}]}]})
+    got = []
+    try:
+        d = K8sDiscovery(got.append, "default", "", 1051,
+                         service="gubernator-tpu-peers",
+                         api_base=fake.url, token="t",
+                         poll_interval_ms=60_000)
+        assert {p.grpc_address for p in got[-1]} == {
+            "10.2.0.1:1051", "10.2.0.2:1051"}
+        # must target the NAMED Endpoints object, not the namespace list
+        assert fake.paths[-1].endswith("/endpoints/gubernator-tpu-peers")
+        d.close()
+    finally:
+        fake.close()
+
+
+def test_k8s_requires_selector_or_service():
+    with pytest.raises(ValueError, match="POD_SELECTOR or"):
+        K8sDiscovery(lambda p: None, "default", "", 1051,
+                     api_base="http://127.0.0.1:1")
+
+
+def test_k8s_outside_cluster_raises(monkeypatch):
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    with pytest.raises(RuntimeError, match="not in a cluster"):
+        K8sDiscovery(lambda p: None, "default", "app=x", 1051)

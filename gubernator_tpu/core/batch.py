@@ -84,11 +84,12 @@ def pack_requests(
     errors = [""] * n
     b.key[:n] = key_hashes if key_hashes is not None else hash_keys(
         [r.key for r in reqs])
+    GREG = int(Behavior.DURATION_IS_GREGORIAN)  # hot loop: plain-int flags
     for i, r in enumerate(reqs):
         behavior = int(r.behavior)
         duration = int(r.duration)
         limit = max(int(r.limit), 0)
-        if behavior & Behavior.DURATION_IS_GREGORIAN:
+        if behavior & GREG:
             try:
                 b.greg_end[i] = gregorian_expiration(now_ms, duration)
                 b.eff_ms[i] = gregorian_rate_duration_ms(duration)

@@ -349,8 +349,10 @@ class EtcdDiscovery(Discovery):
                     json.loads(self._unb64(kv["value"]))))
             except (ValueError, KeyError):
                 continue
-        if peers:
-            self._notify(sorted(peers, key=lambda p: p.grpc_address))
+        # empty-but-successful range = genuinely no registrations (e.g.
+        # our own lease just expired): report it; re-registration on the
+        # next keepalive tick restores membership
+        self._notify(sorted(peers, key=lambda p: p.grpc_address))
 
     def close(self) -> None:
         self._keep.close()
@@ -373,6 +375,7 @@ class K8sDiscovery(Discovery):
     def __init__(self, on_change: OnChange, namespace: str, selector: str,
                  grpc_port: int, service: str = "", api_base: str = "",
                  token: str = "", ca_file: str = "",
+                 insecure_skip_verify: bool = False,
                  poll_interval_ms: int = 15_000):
         super().__init__(on_change)
         self.grpc_port = grpc_port
@@ -400,6 +403,16 @@ class K8sDiscovery(Discovery):
         self.ca_file = ca_file or (
             f"{self.SA_DIR}/ca.crt"
             if os.path.exists(f"{self.SA_DIR}/ca.crt") else "")
+        self.insecure = insecure_skip_verify
+        if (self.api_base.startswith("https") and not self.ca_file
+                and not self.insecure):
+            # never silently skip verification while sending the bearer
+            # token — an impersonated API server could steal it and
+            # inject attacker peers into the ring
+            raise RuntimeError(
+                "k8s discovery: HTTPS API server but no CA cert found; "
+                "provide ca_file or set insecure_skip_verify=True "
+                "explicitly")
         self._poll()
         self._loop = IntervalLoop(poll_interval_ms, self._poll,
                                   name="k8s-discovery")
@@ -418,7 +431,7 @@ class K8sDiscovery(Discovery):
 
         ctx = _ssl.create_default_context(
             cafile=self.ca_file or None)
-        if not self.ca_file:
+        if not self.ca_file and self.insecure:
             ctx.check_hostname = False
             ctx.verify_mode = _ssl.CERT_NONE
         req = urllib.request.Request(self.api_base + path)
@@ -451,9 +464,11 @@ class K8sDiscovery(Discovery):
         except Exception as e:  # noqa: BLE001 - keep last membership
             log.warning("k8s discovery poll: %s", e)
             return
-        if ips:
-            self._notify([PeerInfo(grpc_address=f"{ip}:{self.grpc_port}")
-                          for ip in ips])
+        # an empty SUCCESSFUL result is real membership (all pods
+        # unready): notify it so the instance falls back to local-only
+        # instead of forwarding to dead addresses
+        self._notify([PeerInfo(grpc_address=f"{ip}:{self.grpc_port}")
+                      for ip in ips])
 
     def close(self) -> None:
         self._loop.close()

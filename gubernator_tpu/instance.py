@@ -212,6 +212,11 @@ class V1Instance:
         fwd: List[tuple[int, PeerClient, RateLimitRequest]] = []
 
         have_peers = bool(self.peers())
+        # hot loop: plain-int flag tests (IntFlag.__and__ costs ~µs each
+        # and this loop runs per request)
+        GLOBAL = int(Behavior.GLOBAL)
+        MULTI_REGION = int(Behavior.MULTI_REGION)
+        NO_BATCHING = int(Behavior.NO_BATCHING)
         for i, req in enumerate(reqs):
             if not req.unique_key:
                 responses[i] = RateLimitResponse(
@@ -221,7 +226,8 @@ class V1Instance:
                 responses[i] = RateLimitResponse(
                     error="field 'name' cannot be empty")
                 continue
-            if req.behavior & Behavior.GLOBAL:
+            behavior = int(req.behavior)
+            if behavior & GLOBAL:
                 # Pod-local hot keys take the psum tier: replica-local
                 # decision, consumption folded by one collective per
                 # sync tick (parallel/hotset.py) — no queues at all.
@@ -243,14 +249,14 @@ class V1Instance:
                 continue
             if not have_peers:
                 local_idx.append(i)
-                if req.behavior & Behavior.MULTI_REGION:
+                if behavior & MULTI_REGION:
                     self._ensure_mr_manager().queue_hits(req)
                 continue
             owner = self.owner_of(req.key)
             if owner is None or self.is_self(owner):
                 local_idx.append(i)
                 # local-region owner replicates cross-DC asynchronously
-                if req.behavior & Behavior.MULTI_REGION:
+                if behavior & MULTI_REGION:
                     self._ensure_mr_manager().queue_hits(req)
             else:
                 fwd.append((i, owner, req))
@@ -258,7 +264,7 @@ class V1Instance:
         # forwards first (async futures), so the device step overlaps RPCs
         futures: List[tuple[int, Future]] = []
         for i, peer, req in fwd:
-            if req.behavior & Behavior.NO_BATCHING:
+            if int(req.behavior) & NO_BATCHING:
                 f: Future = Future()
 
                 def _go(peer=peer, req=req, f=f):

@@ -248,6 +248,33 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
     except Exception as e:  # noqa: BLE001
         out["4_global_sharded"] = {"error": str(e)[:200]}
 
+    # -- service path: full V1Instance routing + dispatcher + response
+    # assembly (the analog of benchmark_test.go › BenchmarkServer_
+    # GetRateLimit: what a client sees per node, host costs included).
+    try:
+        from gubernator_tpu.config import Config
+        from gubernator_tpu.instance import V1Instance
+        from gubernator_tpu.parallel import make_mesh
+        from gubernator_tpu.types import RateLimitRequest
+
+        inst = V1Instance(Config(cache_size=1 << 16, sweep_interval_ms=0),
+                          mesh=make_mesh(n=1))
+        reqs5 = [[RateLimitRequest(name="svc", unique_key=f"k{int(k)}",
+                                   hits=1, limit=100, duration=60_000)
+                  for k in rng.zipf(ZIPF_A, size=1000) % 100_000]
+                 for _ in range(4)]
+        inst.get_rate_limits(reqs5[0], now_ms=NOW0)
+        t0 = time.perf_counter()
+        reps = 20
+        for r in range(reps):
+            inst.get_rate_limits(reqs5[r % 4], now_ms=NOW0 + 1 + r)
+        dps_svc = reps * 1000 / (time.perf_counter() - t0)
+        inst.close()
+        out["6_service_path"] = {"decisions_per_s": round(dps_svc),
+                                 "batch": 1000}
+    except Exception as e:  # noqa: BLE001
+        out["6_service_path"] = {"error": str(e)[:200]}
+
     # -- config 5: huge multi-tenant table, Gregorian resets +
     # RESET_REMAINING churn.  Capacity scaled to HBM (~72 B/row).
     try:

@@ -174,6 +174,8 @@ class DaemonConfig:
     k8s_namespace: str = ""
     k8s_pod_selector: str = ""
     k8s_service: str = ""
+    #: Explicit opt-out of API-server cert verification (GUBER_K8S_INSECURE).
+    k8s_insecure_skip_verify: bool = False
     memberlist_known_hosts: List[str] = field(default_factory=list)
 
     #: Path for Loader snapshots ("" disables checkpoint/resume).
@@ -299,6 +301,8 @@ def setup_daemon_config(conf_file: str = "",
     d.k8s_namespace = src.get("GUBER_K8S_NAMESPACE", d.k8s_namespace)
     d.k8s_pod_selector = src.get("GUBER_K8S_POD_SELECTOR", d.k8s_pod_selector)
     d.k8s_service = src.get("GUBER_K8S_SERVICE", d.k8s_service)
+    d.k8s_insecure_skip_verify = src.get("GUBER_K8S_INSECURE",
+                                         d.k8s_insecure_skip_verify, bool)
     ml = src.get("GUBER_MEMBERLIST_KNOWN_HOSTS", "")
     if ml:
         d.memberlist_known_hosts = [p.strip() for p in ml.split(",") if p.strip()]

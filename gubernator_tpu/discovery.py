@@ -411,8 +411,8 @@ class K8sDiscovery(Discovery):
             # inject attacker peers into the ring
             raise RuntimeError(
                 "k8s discovery: HTTPS API server but no CA cert found; "
-                "provide ca_file or set insecure_skip_verify=True "
-                "explicitly")
+                "provide ca_file or set GUBER_K8S_INSECURE=true "
+                "(insecure_skip_verify) explicitly")
         self._poll()
         self._loop = IntervalLoop(poll_interval_ms, self._poll,
                                   name="k8s-discovery")
@@ -510,5 +510,6 @@ def make_discovery(cfg: DaemonConfig, self_info: PeerInfo,
         _, grpc_port = split_host_port(cfg.grpc_listen_address)
         return K8sDiscovery(on_change, cfg.k8s_namespace,
                             cfg.k8s_pod_selector, grpc_port,
-                            service=cfg.k8s_service)
+                            service=cfg.k8s_service,
+                            insecure_skip_verify=cfg.k8s_insecure_skip_verify)
     raise ValueError(f"unknown peer discovery type: {t!r}")

@@ -1,0 +1,123 @@
+"""Pallas TPU kernel: fused expired-row sweep + live-row count.
+
+The decision step itself is deliberately plain XLA (ARCHITECTURE.md §2:
+scattered 72-byte row updates don't map onto TPU DMA, while XLA's dense
+fusion already exceeds the perf target 11×).  The sweep is the opposite
+case — a pure dense streaming pass over the table — which is exactly
+the memory-bound shape Pallas is for, and fusing the occupancy count
+into the same pass halves its HBM traffic vs. sweep-then-count.
+
+TPU Mosaic has no 64-bit vector lanes, so the int64/uint64 columns are
+bit-split into (hi, lo) int32 pairs on the way in and recombined on the
+way out; the expiry comparison is done on the split words (signed hi,
+unsigned lo).  Set ``interpret=True`` (or run on CPU) for the
+reference-interpreter path used by tests.
+
+Usage: ``sweep_expired_pallas(state, now_ms)`` — a drop-in equivalent
+of core/table.py › sweep_expired that also returns the live-row count.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..core.table import TableState
+
+LANES = 128
+BLK = 8  # sublanes per block → (8, 128) int32 tiles
+
+
+def _split64(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """int64/uint64 [n] → (hi int32, lo int32) bit halves."""
+    u = x.astype(jnp.uint64)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
+    lo = u.astype(jnp.uint32).astype(jnp.int32)
+    return hi, lo
+
+
+def _join64(hi: jax.Array, lo: jax.Array, dtype) -> jax.Array:
+    u = (hi.astype(jnp.uint32).astype(jnp.uint64) << jnp.uint64(32)) | \
+        lo.astype(jnp.uint32).astype(jnp.uint64)
+    return u.astype(dtype)
+
+
+def _sweep_kernel(now_ref, khi_ref, klo_ref, ehi_ref, elo_ref,
+                  khi_out, klo_out, ehi_out, elo_out, live_ref):
+    """One (BLK, LANES) tile: zero dead rows, accumulate live count."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        live_ref[0] = 0
+
+    now_hi, now_lo = now_ref[0], now_ref[1]
+    ehi, elo = ehi_ref[:], elo_ref[:]
+    # expire_at <= now on split words: signed hi compare, unsigned lo.
+    # (lo words are reinterpreted-int32; flipping the sign bit makes
+    # int32 compare order match the unsigned order.)
+    flip = jnp.int32(-2147483648)
+    dead = (ehi < now_hi) | ((ehi == now_hi) & (elo ^ flip <= now_lo ^ flip))
+    khi, klo = khi_ref[:], klo_ref[:]
+    empty = (khi == 0) & (klo == 0)
+    dead = dead | empty
+    zero = jnp.zeros_like(khi)
+    khi_out[:] = jnp.where(dead, zero, khi)
+    klo_out[:] = jnp.where(dead, zero, klo)
+    ehi_out[:] = jnp.where(dead, zero, ehi)
+    elo_out[:] = jnp.where(dead, zero, elo)
+    live_ref[0] += jnp.sum((~dead).astype(jnp.int32))
+
+
+def _sweep_2d(khi, klo, ehi, elo, now_hi_lo, *, interpret: bool):
+    rows = khi.shape[0]
+    grid = (rows // BLK,)
+    tile = pl.BlockSpec((BLK, LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((rows, LANES), jnp.int32)
+    return pl.pallas_call(
+        _sweep_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # now (2,) scalar
+            tile, tile, tile, tile,
+        ],
+        out_specs=[tile, tile, tile, tile,
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[out_shape, out_shape, out_shape, out_shape,
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        interpret=interpret,
+    )(now_hi_lo, khi, klo, ehi, elo)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def sweep_expired_pallas(state: TableState, now_ms, *,
+                         interpret: bool = False
+                         ) -> tuple[TableState, jax.Array]:
+    """Fused sweep + occupancy: (new state, live-row count).
+
+    Semantically identical to core/table.py › sweep_expired (dead rows
+    get key=0 AND expire_at=0 so later occupants are unconditionally
+    fresh), plus the live count from the same pass.
+    """
+    cap = state.key.shape[0]
+    if cap % (BLK * LANES):
+        raise ValueError(f"capacity {cap} not a multiple of {BLK * LANES}")
+    shape2d = (cap // LANES, LANES)
+
+    khi, klo = _split64(state.key)
+    ehi, elo = _split64(state.expire_at)
+    nhi, nlo = _split64(jnp.asarray(now_ms, jnp.int64)[None])
+    now_hi_lo = jnp.concatenate([nhi, nlo])
+
+    khi2, klo2, ehi2, elo2, live = _sweep_2d(
+        khi.reshape(shape2d), klo.reshape(shape2d),
+        ehi.reshape(shape2d), elo.reshape(shape2d),
+        now_hi_lo, interpret=interpret)
+
+    new_key = _join64(khi2.reshape(-1), klo2.reshape(-1), jnp.uint64)
+    new_exp = _join64(ehi2.reshape(-1), elo2.reshape(-1), jnp.int64)
+    return state._replace(key=new_key, expire_at=new_exp), live[0]

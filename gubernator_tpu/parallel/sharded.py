@@ -123,17 +123,51 @@ class ShardedEngine:
         self.over_count = 0
         self.insert_count = 0
         self.sweep_count = 0
+        self.live_rows = -1  # set by the fused Pallas sweep
         self._gather = None  # lazily-built row programs
         self._upsert = None
+        self._pallas_sweep_fn = None
 
     def sweep(self, now_ms: int) -> None:
         """Reclaim expired rows on every shard (elementwise on the
         sharded arrays — no collective).  The eviction analog of the
-        reference's LRU + expired-entry handling (lrucache.go)."""
-        from ..core.table import sweep_expired
+        reference's LRU + expired-entry handling (lrucache.go).
 
-        self.state = sweep_expired(self.state, np.int64(now_ms))
+        With GUBER_PALLAS_SWEEP=1 the fused Pallas kernel runs instead
+        (same semantics + live count in one streaming pass; see
+        ops/pallas_sweep.py)."""
+        import os
+
+        if os.environ.get("GUBER_PALLAS_SWEEP") == "1" and \
+                self.cap_local % 1024 == 0:
+            self.state, live = self._pallas_sweep(now_ms)
+            self.live_rows = int(live)
+        else:
+            from ..core.table import sweep_expired
+
+            self.state = sweep_expired(self.state, np.int64(now_ms))
         self.sweep_count += 1
+
+    def _pallas_sweep(self, now_ms: int):
+        """shard_map'd fused sweep: per-shard Pallas pass + psum'd live
+        count.  Interpret mode off-TPU (Mosaic kernels are TPU-only)."""
+        if self._pallas_sweep_fn is None:
+            from ..ops.pallas_sweep import sweep_expired_pallas
+
+            interpret = jax.default_backend() != "tpu"
+
+            def _one(state, now):
+                st, live = sweep_expired_pallas(state, now,
+                                                interpret=interpret)
+                return st, lax.psum(live, SHARD_AXIS)
+
+            # check_vma=False: pallas_call's out_shape carries no
+            # varying-mesh-axes annotation
+            self._pallas_sweep_fn = jax.jit(shard_map(
+                _one, mesh=self.mesh, in_specs=(P(SHARD_AXIS), P()),
+                out_specs=(P(SHARD_AXIS), P()), check_vma=False))
+        return self._pallas_sweep_fn(self.state, jnp.asarray(now_ms,
+                                                             jnp.int64))
 
     def _put_batch(self, b: RequestBatch) -> RequestBatch:
         return RequestBatch(*[
@@ -155,11 +189,13 @@ class ShardedEngine:
         retried: set = set()
         while pending:
             wave: List[int] = []
+            wave_pos: List[int] = []  # block slot, assigned at admission
             fill = [0] * self.n
             rest: List[int] = []
             for i in pending:
                 s = int(shard[i])
                 if fill[s] < self.B:
+                    wave_pos.append(s * self.B + fill[s])
                     fill[s] += 1
                     wave.append(i)
                 else:
@@ -170,16 +206,11 @@ class ShardedEngine:
             packed, errs = pack_requests([reqs[i] for i in wave], now_ms,
                                          size=len(wave),
                                          key_hashes=khash[wave])
-            positions = np.empty(len(wave), np.int64)
-            fill2 = [0] * self.n
-            for j, i in enumerate(wave):
-                s = int(shard[i])
-                positions[j] = s * self.B + fill2[s]
-                fill2[s] += 1
             glob = empty_batch(self.n * self.B)
+            positions = np.asarray(wave_pos, np.int64)
             for f in range(len(glob)):
                 np.asarray(glob[f])[positions] = packed[f][:len(wave)]
-            slot_of = list(zip(wave, positions.tolist()))
+            slot_of = list(zip(wave, wave_pos))
             errs_all = {i: errs[j] for j, i in enumerate(wave) if errs[j]}
             dev_batch = self._put_batch(glob)
             self.state, outs, counters = self._step(

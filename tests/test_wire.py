@@ -1,0 +1,76 @@
+"""Wire-converter round-trips (dataclass ↔ pb2) and enum parity with
+the reference contract (SURVEY.md §2.4)."""
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    HealthCheckResponse,
+    RateLimitRequest,
+    RateLimitResponse,
+    Status,
+)
+from gubernator_tpu.wire import (
+    health_to_pb,
+    req_from_pb,
+    req_to_pb,
+    reqs_to_pb,
+    resp_from_pb,
+    resp_to_pb,
+)
+
+
+def test_enum_values_match_reference_contract():
+    assert pb.TOKEN_BUCKET == 0 and pb.LEAKY_BUCKET == 1
+    assert pb.UNDER_LIMIT == 0 and pb.OVER_LIMIT == 1
+    assert (pb.BATCHING, pb.NO_BATCHING, pb.GLOBAL,
+            pb.DURATION_IS_GREGORIAN, pb.RESET_REMAINING,
+            pb.MULTI_REGION, pb.DRAIN_OVER_LIMIT) == (0, 1, 2, 4, 8, 16, 32)
+
+
+def test_request_round_trip_with_combined_flags():
+    r = RateLimitRequest(
+        name="svc", unique_key="user:1", hits=3, limit=50, duration=9000,
+        algorithm=Algorithm.LEAKY_BUCKET,
+        behavior=Behavior.GLOBAL | Behavior.RESET_REMAINING,  # 10: no alias
+        burst=70, metadata={"trace": "abc"})
+    m = req_to_pb(r)
+    assert m.behavior == 10  # open enum preserves bit combos on the wire
+    back = req_from_pb(pb.RateLimitReq.FromString(m.SerializeToString()))
+    assert back == r
+
+
+def test_response_round_trip():
+    r = RateLimitResponse(status=Status.OVER_LIMIT, limit=5, remaining=0,
+                          reset_time=1_760_000_000_123, error="x",
+                          metadata={"m": "1"})
+    back = resp_from_pb(pb.RateLimitResp.FromString(
+        resp_to_pb(r).SerializeToString()))
+    assert back == r
+
+
+def test_batch_and_health():
+    m = reqs_to_pb([RateLimitRequest(name="a", unique_key="b"),
+                    RateLimitRequest(name="c", unique_key="d")])
+    assert len(m.requests) == 2 and m.requests[1].name == "c"
+    h = health_to_pb(HealthCheckResponse(status="unhealthy", message="m",
+                                         peer_count=3))
+    assert (h.status, h.message, h.peer_count) == ("unhealthy", "m", 3)
+
+
+def test_update_peer_global_message_shape():
+    g = peers_pb.UpdatePeerGlobal(
+        key="a_b", algorithm=pb.LEAKY_BUCKET, duration=1000,
+        created_at=123, behavior=pb.GLOBAL, burst=9,
+        update=pb.RateLimitResp(status=pb.OVER_LIMIT, limit=5, remaining=0,
+                                reset_time=456))
+    back = peers_pb.UpdatePeerGlobal.FromString(g.SerializeToString())
+    assert back.key == "a_b" and back.update.reset_time == 456
+    assert back.burst == 9 and back.behavior == pb.GLOBAL
+
+
+def test_grpc_method_paths_match_reference():
+    from gubernator_tpu.grpc_api import PEERS_SERVICE, V1_SERVICE
+
+    assert V1_SERVICE == "pb.gubernator.V1"
+    assert PEERS_SERVICE == "pb.gubernator.PeersV1"

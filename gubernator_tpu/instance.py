@@ -624,6 +624,19 @@ class V1Instance:
         return HealthCheckResponse(status=status, message=msg,
                                    peer_count=len(self.peers()))
 
+    def remove(self, name: str, unique_key: str) -> bool:
+        """Delete one rate limit's state (library admin path; the
+        reference exposes the same through its Cache.Remove + Store).
+        Returns True when a row existed."""
+        kh = hash_key(name, unique_key)
+        if self._hotset is not None and self._hotset.is_pinned(kh):
+            self._demote(kh)
+        with self._engine_mu:
+            n = self.engine.remove_rows(np.array([kh], np.uint64))
+        if self.store is not None:
+            self.store.remove(f"{name}_{unique_key}")
+        return n > 0
+
     def engine_occupancy(self) -> int:
         from .core.table import occupancy
 

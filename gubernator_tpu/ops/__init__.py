@@ -1,5 +1,8 @@
-"""Host-side ops: optional native (C++) fast paths.
+"""Accelerated ops.
 
-``from gubernator_tpu.ops import native`` raises ImportError when the
-extension isn't built (``make native``); callers fall back to numpy.
+- ``native``: C++ host fast paths (batch key hashing); importing it
+  raises ImportError when the extension isn't built (``make native``)
+  and callers fall back to numpy.
+- ``pallas_sweep``: Pallas TPU kernel for the fused expired-row sweep
+  (enabled via GUBER_PALLAS_SWEEP=1).
 """

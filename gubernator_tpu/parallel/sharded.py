@@ -52,6 +52,27 @@ def make_gather_rows(mesh):
         out_specs=P(SHARD_AXIS)))
 
 
+def make_remove_rows(mesh):
+    """jit program: probe-lookup a [n·B] key block and clear matched
+    rows (key + expire → 0).  The Cache.Remove analog (cache.go) —
+    used by the Store-backed admin path."""
+
+    def _remove(state, keys):
+        slots = _probe_slots(keys, state.key.shape[0])
+        row, _ = _lookup(state.key, slots, keys)
+        found = (keys != 0) & (row >= 0)
+        wrow = jnp.where(found, row, state.key.shape[0])
+        return state._replace(
+            key=state.key.at[wrow].set(jnp.uint64(0), mode="drop"),
+            expire_at=state.expire_at.at[wrow].set(jnp.int64(0),
+                                                   mode="drop"),
+        ), found
+
+    return jax.jit(shard_map(
+        _remove, mesh=mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS))))
+
+
 def make_upsert_rows(mesh):
     """jit program: find-or-insert a [n·B] key block per shard and
     overwrite the value columns — the replica-side write for GLOBAL
@@ -126,6 +147,7 @@ class ShardedEngine:
         self.live_rows = -1  # set by the fused Pallas sweep
         self._gather = None  # lazily-built row programs
         self._upsert = None
+        self._remove = None
         self._pallas_sweep_fn = None
 
     def sweep(self, now_ms: int) -> None:
@@ -313,6 +335,27 @@ class ShardedEngine:
                 tuple(block_cols))
             placed_total += int(np.asarray(placed)[slots].sum())
         return placed_total
+
+    def remove_rows(self, khash: np.ndarray) -> int:
+        """Delete rows by key hash (Cache.Remove analog); returns the
+        number of rows actually removed."""
+        if self._remove is None:
+            self._remove = make_remove_rows(self.mesh)
+        removed = 0
+        for wave, slots in self._route_waves(khash):
+            keys = np.zeros(self.n * self.B, np.uint64)
+            keys[slots] = khash[wave]
+            self.state, found = self._remove(
+                self.state, jax.device_put(keys, self._batch_sharding))
+            removed += int(np.asarray(found)[slots].sum())
+        return removed
+
+    def each(self):
+        """Iterate live rows as store.CacheItem objects (Cache.Each
+        analog) — a host-side snapshot walk, for admin/debug tooling."""
+        from ..store import items_from_arrays
+
+        yield from items_from_arrays(self.snapshot())
 
     # ---- checkpoint/resume (store.py › Loader array fast path) ---------
 

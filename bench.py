@@ -15,10 +15,17 @@ vs_baseline is relative to the 50M decisions/s/chip north-star target
 (BASELINE.json records no published reference numbers).
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# Persistent compile cache: the decision-step program is large and a
+# cold TPU compile is minutes; cache across bench invocations.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/gubernator_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 def log(*a):

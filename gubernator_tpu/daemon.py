@@ -101,8 +101,11 @@ def _json_to_req(o: dict) -> RateLimitRequest:
 
 
 def _resp_to_json(r) -> dict:
+    # grpc-gateway emits proto JSON names (camelCase); keep snake_case
+    # too so existing simple clients keep working
     return {"status": int(r.status), "limit": r.limit,
-            "remaining": r.remaining, "reset_time": r.reset_time,
+            "remaining": r.remaining,
+            "reset_time": r.reset_time, "resetTime": r.reset_time,
             "error": r.error, "metadata": r.metadata}
 
 

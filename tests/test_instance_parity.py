@@ -1,0 +1,59 @@
+"""Instance-level parity: the FULL service stack (routing + dispatcher
++ engine + response assembly) must match the sequential oracle exactly
+for every non-GLOBAL behavior.  (GLOBAL is eventually consistent by
+contract and is covered by convergence tests instead.)"""
+import numpy as np
+import pytest
+
+from gubernator_tpu import Algorithm, Behavior, Oracle, RateLimitRequest
+from gubernator_tpu.config import Config
+from gubernator_tpu.instance import V1Instance
+from gubernator_tpu.parallel import make_mesh
+
+NOW = 1_770_000_000_000
+
+
+@pytest.fixture(scope="module")
+def inst():
+    i = V1Instance(Config(cache_size=1 << 12, sweep_interval_ms=0),
+                   mesh=make_mesh(n=4))
+    yield i
+    i.close()
+
+
+def test_mixed_stream_parity(inst):
+    rng = np.random.default_rng(99)
+    oracle = Oracle()
+    now = NOW
+    behaviors = [Behavior.BATCHING, Behavior.NO_BATCHING,
+                 Behavior.RESET_REMAINING, Behavior.DRAIN_OVER_LIMIT,
+                 Behavior.DURATION_IS_GREGORIAN]
+    for wave in range(6):
+        reqs = []
+        for _ in range(200):
+            b = behaviors[rng.integers(len(behaviors))]
+            greg = bool(b & Behavior.DURATION_IS_GREGORIAN)
+            reqs.append(RateLimitRequest(
+                name="ipar", unique_key=f"k{rng.integers(0, 60)}",
+                hits=int(rng.integers(0, 4)),
+                limit=int(rng.integers(1, 25)),
+                duration=int(rng.integers(0, 3)) if greg
+                else int(rng.integers(500, 120_000)),
+                algorithm=Algorithm.LEAKY_BUCKET
+                if (not greg and rng.integers(2)) else Algorithm.TOKEN_BUCKET,
+                behavior=b))
+        want = oracle.check_batch(reqs, now)
+        got = inst.get_rate_limits(reqs, now_ms=now)
+        for i, (w, g) in enumerate(zip(want, got)):
+            assert g.error == "", (i, g.error)
+            assert (int(g.status), g.remaining, g.reset_time, g.limit) == \
+                (int(w.status), w.remaining, w.reset_time, w.limit), \
+                (wave, i, reqs[i])
+        now += int(rng.integers(1, 30_000))
+
+
+def test_invalid_gregorian_surfaces_error(inst):
+    r = inst.get_rate_limits([RateLimitRequest(
+        name="ipar", unique_key="bad", hits=1, limit=5, duration=99,
+        behavior=Behavior.DURATION_IS_GREGORIAN)], now_ms=NOW)[0]
+    assert "gregorian" in r.error

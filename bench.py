@@ -130,6 +130,28 @@ def main():
     p99 = float(np.percentile(lats, 99))
     log(f"latency: p50={p50:.3f}ms p99={p99:.3f}ms (batch={B})")
 
+    # client-shaped latency: one max-size GetRateLimits batch (1000 reqs
+    # in a 1024 bucket) per device call — the p99<2ms target's shape
+    Bc = 1024
+    small = RequestBatch(
+        key=key_batches[0][:Bc],
+        **{k: (v[:Bc] if hasattr(v, "shape") else v)
+           for k, v in const.items()})
+    state_c = init_table(CAP)
+    state_c, outc = decide_batch(state_c, small, jnp.asarray(NOW0, i64))
+    outc.status.block_until_ready()
+    lats_c = []
+    for i in range(100):
+        t0 = time.perf_counter()
+        state_c, outc = decide_batch(state_c, small,
+                                     jnp.asarray(NOW0 + i, i64))
+        outc.status.block_until_ready()
+        lats_c.append((time.perf_counter() - t0) * 1e3)
+    p50_c = float(np.percentile(lats_c, 50))
+    p99_c = float(np.percentile(lats_c, 99))
+    log(f"client-batch latency: p50={p50_c:.3f}ms p99={p99_c:.3f}ms "
+        f"(batch={Bc})")
+
     # host-side string-hash throughput (the other half of a real dispatch)
     from gubernator_tpu.hashing import hash_keys
     names = [f"bench_k{i}" for i in range(100_000)]
@@ -147,6 +169,8 @@ def main():
         "extra": {
             "p50_ms": round(p50, 3),
             "p99_ms": round(p99, 3),
+            "client_batch_p50_ms": round(p50_c, 3),
+            "client_batch_p99_ms": round(p99_c, 3),
             "device_batch": B,
             "host_hash_mkeys_per_s": round(hash_mkeys, 2),
             "backend": backend,

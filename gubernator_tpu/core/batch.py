@@ -19,7 +19,7 @@ import numpy as np
 
 from ..gregorian import gregorian_expiration, gregorian_rate_duration_ms
 from ..hashing import hash_keys
-from ..types import Algorithm, Behavior, GregorianDuration, RateLimitRequest
+from ..types import Behavior, RateLimitRequest
 
 #: Batch sizes are rounded up to one of these to bound compile cache size.
 BATCH_BUCKETS = (64, 256, 1024, 4096)

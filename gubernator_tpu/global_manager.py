@@ -20,12 +20,10 @@ import threading
 import time
 from typing import Dict, List, Tuple
 
-import numpy as np
-
 from .config import BehaviorConfig
 from .interval import IntervalLoop
 from .proto import peers_pb2 as peers_pb
-from .types import Algorithm, Behavior, RateLimitRequest
+from .types import Behavior, RateLimitRequest
 
 log = logging.getLogger("gubernator_tpu.global")
 

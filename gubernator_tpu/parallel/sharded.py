@@ -12,7 +12,6 @@ on exactly one shard, so owner-applies-hits parity is exact.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Sequence
 
 import jax
@@ -25,7 +24,7 @@ from jax import shard_map
 from ..hashing import shard_of
 from ..types import RateLimitRequest, RateLimitResponse, Status
 from ..core.batch import RequestBatch, empty_batch, pack_requests
-from ..core.step import StepOutput, decide_batch_impl, _insert, _lookup, _probe_slots
+from ..core.step import decide_batch_impl, _insert, _lookup, _probe_slots
 from ..core.table import TableState
 from .mesh import SHARD_AXIS, make_mesh, shard_table, table_sharding
 

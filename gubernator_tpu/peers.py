@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-from typing import Callable, Dict, Generic, List, Optional, Sequence, TypeVar
+from typing import Callable, Dict, Generic, List, Optional, TypeVar
 
 from .hashing import fnv1a64, mixed_fnv1a64
 from .types import PeerInfo

@@ -11,7 +11,7 @@ import datetime
 import os
 import ssl
 import tempfile
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from .config import TLSSettings

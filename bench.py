@@ -33,7 +33,9 @@ def log(*a):
 
 
 CAP = 1 << 21          # 2M rows for 1M keys (load factor 0.5)
-B = 65536              # device batch = 64 coalesced client batches of 1024
+#: device batch = coalesced client batches of 1024 (GUBER_BENCH_B overrides
+#: for batch-size sweeps on real hardware)
+B = int(os.environ.get("GUBER_BENCH_B", 65536))
 N_KEYS = 1_000_000
 ZIPF_A = 1.1
 LIMIT = 100

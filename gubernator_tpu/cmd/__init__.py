@@ -1,1 +1,17 @@
 """Command-line entry points (reference: cmd/ binaries)."""
+
+
+def maybe_pin_platform() -> None:
+    """Honor GUBER_JAX_PLATFORM=cpu|tpu before any backend init.
+
+    Must go through jax.config: some sandboxes overwrite the
+    jax_platforms config at interpreter start, so the JAX_PLATFORMS env
+    var alone is ignored.  Every jax-using CLI calls this first.
+    """
+    import os
+
+    plat = os.environ.get("GUBER_JAX_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

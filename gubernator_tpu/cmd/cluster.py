@@ -18,6 +18,10 @@ def main(argv=None) -> int:
     ap.add_argument("--cache-size", type=int, default=1 << 16)
     args = ap.parse_args(argv)
 
+    from . import maybe_pin_platform
+
+    maybe_pin_platform()
+
     from ..cluster import start_with
     from ..config import DaemonConfig
 

@@ -20,16 +20,9 @@ def main(argv=None) -> int:
     ap.add_argument("--http", default="", help="override GUBER_HTTP_ADDRESS")
     args = ap.parse_args(argv)
 
-    # Optional backend pin (GUBER_JAX_PLATFORM=cpu|tpu).  Must go through
-    # jax.config: some sandboxes overwrite the jax_platforms config at
-    # interpreter start, so the JAX_PLATFORMS env var alone is ignored.
-    import os
+    from . import maybe_pin_platform
 
-    plat = os.environ.get("GUBER_JAX_PLATFORM", "")
-    if plat:
-        import jax
-
-        jax.config.update("jax_platforms", plat)
+    maybe_pin_platform()
 
     from ..config import setup_daemon_config
     from ..daemon import spawn_daemon

@@ -34,8 +34,10 @@ def log(*a):
 
 CAP = 1 << 21          # 2M rows for 1M keys (load factor 0.5)
 #: device batch = coalesced client batches of 1024 (GUBER_BENCH_B overrides
-#: for batch-size sweeps on real hardware)
-B = int(os.environ.get("GUBER_BENCH_B", 65536))
+#: for batch-size sweeps; GUBER_BENCH_FAST=1 shrinks the program for
+#: cold-compile-constrained runs)
+B = int(os.environ.get("GUBER_BENCH_B",
+                       8192 if os.environ.get("GUBER_BENCH_FAST") else 65536))
 N_KEYS = 1_000_000
 ZIPF_A = 1.1
 LIMIT = 100

@@ -85,10 +85,11 @@ def pack_requests(
     b.key[:n] = key_hashes if key_hashes is not None else hash_keys(
         [r.key for r in reqs])
     GREG = int(Behavior.DURATION_IS_GREGORIAN)  # hot loop: plain-int flags
+    MAXI = (1 << 31) - 1  # oracle.MAX_INPUT: keeps td products in int64
     for i, r in enumerate(reqs):
         behavior = int(r.behavior)
-        duration = int(r.duration)
-        limit = max(int(r.limit), 0)
+        duration = min(int(r.duration), MAXI)
+        limit = min(max(int(r.limit), 0), MAXI)
         if behavior & GREG:
             try:
                 b.greg_end[i] = gregorian_expiration(now_ms, duration)
@@ -99,11 +100,11 @@ def pack_requests(
                 continue
         else:
             b.eff_ms[i] = max(duration, 1)
-        b.hits[i] = max(int(r.hits), 0)
+        b.hits[i] = min(max(int(r.hits), 0), MAXI)
         b.limit[i] = limit
         b.duration[i] = duration
         b.behavior[i] = behavior
         b.algorithm[i] = int(r.algorithm)
-        b.burst[i] = int(r.burst) if int(r.burst) > 0 else limit
+        b.burst[i] = min(int(r.burst), MAXI) if int(r.burst) > 0 else limit
         b.valid[i] = True
     return b, errors

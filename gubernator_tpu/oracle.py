@@ -116,11 +116,21 @@ def _token_expire(now_ms: int, created_ms: int, duration: int, behavior: int) ->
     return created_ms + max(int(duration), 1)
 
 
+#: Input ceiling for hits/limit/burst and ms durations: keeps every td
+#: fixed-point product (value × duration_eff) inside int64 —
+#: 2^31 × 2^31 < 2^63.  Clamped identically by the device batch packer
+#: (core/batch.py) so parity holds on adversarial inputs.  The duration
+#: ceiling is ~24.8 days; calendar-scale windows are what
+#: DURATION_IS_GREGORIAN exists for.
+MAX_INPUT = (1 << 31) - 1
+
+
 def _clamp_req(req: RateLimitRequest) -> Tuple[int, int, int, int]:
-    hits = max(int(req.hits), 0)
-    limit = max(int(req.limit), 0)
-    duration = int(req.duration)
+    hits = min(max(int(req.hits), 0), MAX_INPUT)
+    limit = min(max(int(req.limit), 0), MAX_INPUT)
+    duration = min(int(req.duration), MAX_INPUT)
     burst = int(req.burst) if int(req.burst) > 0 else limit
+    burst = min(burst, MAX_INPUT)
     return hits, limit, duration, burst
 
 

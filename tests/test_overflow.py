@@ -1,0 +1,63 @@
+"""Adversarial-input parity: huge hits/limit/burst/duration must not
+overflow int64 fixed-point products, and the device must agree with the
+oracle after clamping (oracle.MAX_INPUT)."""
+import numpy as np
+import pytest
+
+from gubernator_tpu import Algorithm, Behavior, Oracle, RateLimitRequest
+from gubernator_tpu.oracle import MAX_INPUT
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+
+NOW = 1_772_000_000_000
+
+HUGE = [2**31, 2**40, 2**62, 2**63 - 1]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+
+
+def test_huge_inputs_parity(engine):
+    oracle = Oracle()
+    reqs = []
+    for j, h in enumerate(HUGE):
+        for alg in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET):
+            reqs.append(RateLimitRequest(
+                name="ovf", unique_key=f"h{j}_{int(alg)}", hits=h,
+                limit=h, duration=h, algorithm=alg, burst=h))
+            reqs.append(RateLimitRequest(
+                name="ovf", unique_key=f"m{j}_{int(alg)}", hits=1,
+                limit=h, duration=h, algorithm=alg))
+    now = NOW
+    for wave in range(2):
+        want = oracle.check_batch(reqs, now)
+        got = engine.check_batch(reqs, now)
+        for i, (w, g) in enumerate(zip(want, got)):
+            assert g.error == ""
+            assert (int(g.status), g.remaining, g.reset_time, g.limit) == \
+                (int(w.status), w.remaining, w.reset_time, w.limit), \
+                (wave, i, reqs[i])
+        now += 10_000
+
+
+def test_clamped_values_stay_in_int64(engine):
+    """The leaky td fixed point at the clamp ceiling must not wrap."""
+    r = RateLimitRequest(name="ovf", unique_key="edge", hits=1,
+                         limit=2**63 - 1, duration=2**63 - 1,
+                         algorithm=Algorithm.LEAKY_BUCKET, burst=2**63 - 1)
+    got = engine.check_batch([r], NOW)[0]
+    assert got.error == ""
+    assert 0 <= got.remaining <= MAX_INPUT
+    assert got.limit == MAX_INPUT
+
+
+def test_negative_inputs_clamp_to_zero(engine):
+    oracle = Oracle()
+    r = RateLimitRequest(name="ovf", unique_key="neg", hits=-5, limit=-1,
+                         duration=-100)
+    w = oracle.check_batch([r], NOW)[0]
+    g = engine.check_batch([r], NOW)[0]
+    assert (int(g.status), g.remaining, g.limit) == \
+        (int(w.status), w.remaining, w.limit)

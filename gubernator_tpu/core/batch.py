@@ -104,7 +104,11 @@ def pack_requests(
         b.limit[i] = limit
         b.duration[i] = duration
         b.behavior[i] = behavior
-        b.algorithm[i] = int(r.algorithm)
+        # clamp to {0,1}: any other wire value must mean TOKEN_BUCKET
+        # (like the oracle's `== LEAKY_BUCKET` test) — an unclamped
+        # value would never equal the stored alg&1 and the row would
+        # re-create fresh on every request, bypassing the limit
+        b.algorithm[i] = 1 if int(r.algorithm) == 1 else 0
         b.burst[i] = min(int(r.burst), MAXI) if int(r.burst) > 0 else limit
         b.valid[i] = True
     return b, errors

@@ -74,7 +74,7 @@ GREGORIAN_APPROX_MS = {
 }
 
 
-@dataclass
+@dataclass(slots=True)
 class RateLimitRequest:
     """reference: gubernator.proto › RateLimitReq.
 
@@ -87,8 +87,11 @@ class RateLimitRequest:
     hits: int = 1
     limit: int = 0
     duration: int = 0  # milliseconds, or GregorianDuration ordinal
-    algorithm: Algorithm = Algorithm.TOKEN_BUCKET
-    behavior: Behavior = Behavior.BATCHING
+    #: Algorithm/Behavior accept plain ints: the gRPC ingest path keeps
+    #: raw wire values (enum construction costs µs per request), and
+    #: Behavior bit-combos aren't valid single members anyway.
+    algorithm: Algorithm | int = Algorithm.TOKEN_BUCKET
+    behavior: Behavior | int = Behavior.BATCHING
     burst: int = 0  # 0 → defaults to limit (leaky bucket only)
     metadata: Dict[str, str] = field(default_factory=dict)
 
@@ -97,7 +100,7 @@ class RateLimitRequest:
         return self.name + "_" + self.unique_key
 
 
-@dataclass
+@dataclass(slots=True)
 class RateLimitResponse:
     """reference: gubernator.proto › RateLimitResp."""
 

@@ -32,17 +32,22 @@ def req_to_pb(r: RateLimitRequest) -> pb.RateLimitReq:
 
 
 def req_from_pb(m: pb.RateLimitReq) -> RateLimitRequest:
+    # plain ints, not enum construction: Behavior(...)/Algorithm(...)
+    # cost ~2µs each and this runs per request on the ingest hot path
+    # (every consumer does int(req.behavior) anyway, and bit-combos
+    # aren't valid single Behavior members)
     return RateLimitRequest(
         name=m.name, unique_key=m.unique_key, hits=m.hits, limit=m.limit,
-        duration=m.duration, algorithm=Algorithm(m.algorithm),
-        behavior=Behavior(m.behavior), burst=m.burst,
-        metadata=dict(m.metadata))
+        duration=m.duration, algorithm=m.algorithm, behavior=m.behavior,
+        burst=m.burst, metadata=dict(m.metadata) if m.metadata else {})
 
 
 def resp_to_pb(r: RateLimitResponse) -> pb.RateLimitResp:
     m = pb.RateLimitResp(
         status=int(r.status), limit=int(r.limit), remaining=int(r.remaining),
-        reset_time=int(r.reset_time), error=r.error)
+        reset_time=int(r.reset_time))
+    if r.error:
+        m.error = r.error
     for k, v in r.metadata.items():
         m.metadata[k] = v
     return m

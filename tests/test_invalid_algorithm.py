@@ -1,0 +1,21 @@
+"""An out-of-range wire algorithm must degrade to TOKEN_BUCKET and
+still enforce the limit — an unclamped value would re-create the bucket
+fresh on every request (limit bypass)."""
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.types import RateLimitRequest, Status
+
+NOW = 1_773_000_000_000
+
+
+def test_unknown_algorithm_still_rate_limits(cpu_mesh):
+    eng = ShardedEngine(cpu_mesh, capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+    req = RateLimitRequest(name="alg", unique_key="x", hits=1, limit=2,
+                           duration=60_000, algorithm=7)  # not 0/1
+    r1 = eng.check_batch([req], NOW)[0]
+    r2 = eng.check_batch([req], NOW + 1)[0]
+    r3 = eng.check_batch([req], NOW + 2)[0]
+    assert (int(r1.status), r1.remaining) == (0, 1)
+    assert (int(r2.status), r2.remaining) == (0, 0)
+    assert int(r3.status) == int(Status.OVER_LIMIT), \
+        "unknown algorithm bypassed the limit (fresh-bucket loop)"

@@ -310,6 +310,41 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
     except Exception as e:  # noqa: BLE001
         out["6_service_path"] = {"error": str(e)[:200]}
 
+    # -- hot-set psum tier: replica-local GLOBAL decisions + one psum
+    # fold per sync (the north-star replacement for global.go).
+    try:
+        from gubernator_tpu.hashing import hash_key
+        from gubernator_tpu.parallel import HotSetEngine, make_mesh
+        from gubernator_tpu.types import RateLimitRequest
+
+        mesh = make_mesh()
+        hot = HotSetEngine(mesh, capacity=1024, batch_per_chip=2048)
+        n = hot.n
+        hreq = RateLimitRequest(name="hot", unique_key="k", hits=1,
+                                limit=10**9, duration=600_000)
+        hkh = hash_key("hot", "k")
+        hot.pin(hreq, hkh, NOW0)
+        wave = [hreq] * (n * 2048)
+        khs = [hkh] * len(wave)
+        hot.check_batch(wave, khs, NOW0)  # compile
+        t0 = time.perf_counter()
+        reps = 10
+        for r in range(reps):
+            hot.check_batch(wave, khs, NOW0 + 1 + r)
+        dps_hot = reps * len(wave) / (time.perf_counter() - t0)
+        hot.sync()
+        jax.block_until_ready(hot.state)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            hot.sync()
+        jax.block_until_ready(hot.state)  # async dispatch: wait for the fold
+        sync_ms = (time.perf_counter() - t0) / 20 * 1e3
+        out["7_hot_psum"] = {"decisions_per_s": round(dps_hot),
+                             "sync_ms": round(sync_ms, 3),
+                             "n_replicas": int(n)}
+    except Exception as e:  # noqa: BLE001
+        out["7_hot_psum"] = {"error": str(e)[:200]}
+
     # -- config 5: huge multi-tenant table, Gregorian resets +
     # RESET_REMAINING churn.  Capacity scaled to HBM (~72 B/row).
     try:

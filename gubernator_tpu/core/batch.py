@@ -24,6 +24,9 @@ from ..types import Behavior, RateLimitRequest
 #: Batch sizes are rounded up to one of these to bound compile cache size.
 BATCH_BUCKETS = (64, 256, 1024, 4096)
 
+#: oracle.MAX_INPUT: keeps td products in int64
+MAX_INPUT = (1 << 31) - 1
+
 
 class RequestBatch(NamedTuple):
     """Fixed-shape [B] device view of a GetRateLimitsReq batch."""
@@ -81,11 +84,11 @@ def pack_requests(
     """
     n = len(reqs)
     b = empty_batch(size if size is not None else bucket_size(n))
+    MAXI = MAX_INPUT
     errors = [""] * n
     b.key[:n] = key_hashes if key_hashes is not None else hash_keys(
         [r.key for r in reqs])
     GREG = int(Behavior.DURATION_IS_GREGORIAN)  # hot loop: plain-int flags
-    MAXI = (1 << 31) - 1  # oracle.MAX_INPUT: keeps td products in int64
     for i, r in enumerate(reqs):
         behavior = int(r.behavior)
         duration = min(int(r.duration), MAXI)
@@ -111,4 +114,57 @@ def pack_requests(
         b.algorithm[i] = 1 if int(r.algorithm) == 1 else 0
         b.burst[i] = min(int(r.burst), MAXI) if int(r.burst) > 0 else limit
         b.valid[i] = True
+    return b, errors
+
+
+def pack_columns(
+    khash: np.ndarray,
+    hits: np.ndarray,
+    limit: np.ndarray,
+    duration: np.ndarray,
+    algorithm: np.ndarray,
+    behavior: np.ndarray,
+    burst: np.ndarray,
+    now_ms: int,
+) -> tuple[RequestBatch, dict]:
+    """Vectorized pack of already-columnar requests (the C++ wire-ingest
+    lane, ops/_native.cpp › parse_get_rate_limits) → RequestBatch.
+
+    Same clamps and semantics as ``pack_requests``, applied as array ops
+    — no per-request Python.  Returns (batch, errors) where errors maps
+    request index → error string (invalid Gregorian ordinals, as on the
+    pb2 path).  ``khash`` must already be mixed and zero-remapped.
+    """
+    n = len(khash)
+    MAXI = MAX_INPUT
+    lim = np.clip(limit, 0, MAXI)
+    dur = np.minimum(duration, MAXI)
+    b = RequestBatch(
+        key=khash.astype(np.uint64).copy(),
+        hits=np.clip(hits, 0, MAXI),
+        limit=lim,
+        duration=dur.copy(),
+        eff_ms=np.maximum(dur, 1),
+        greg_end=np.zeros(n, np.int64),
+        behavior=behavior.astype(np.int32),
+        algorithm=(algorithm == 1).astype(np.int32),
+        burst=np.where(burst > 0, np.minimum(burst, MAXI), lim),
+        valid=np.ones(n, bool),
+    )
+    errors: dict = {}
+    greg = (b.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    if greg.any():
+        # ≤ a handful of distinct calendar ordinals per batch: compute
+        # each period end once on the host, broadcast to its requests
+        for d in np.unique(dur[greg]):
+            m = greg & (dur == d)
+            try:
+                b.greg_end[m] = gregorian_expiration(now_ms, int(d))
+                b.eff_ms[m] = gregorian_rate_duration_ms(int(d))
+            except (ValueError, KeyError):
+                b.valid[m] = False
+                b.key[m] = 0
+                msg = f"invalid gregorian duration ordinal: {int(d)}"
+                for i in np.nonzero(m)[0]:
+                    errors[int(i)] = msg
     return b, errors

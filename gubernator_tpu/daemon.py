@@ -22,7 +22,7 @@ import grpc
 
 from .config import DaemonConfig
 from .discovery import make_discovery
-from .grpc_api import add_peers_servicer, add_v1_servicer
+from .grpc_api import add_peers_servicer, add_v1_servicer_raw
 from .instance import V1Instance
 from .netutil import resolve_host_ip, split_host_port
 from .proto import gubernator_pb2 as pb
@@ -50,6 +50,16 @@ class _V1Servicer:
             out = pb.GetRateLimitsResp()
             out.responses.extend(resp_to_pb(r) for r in resps)
             return out
+
+    def GetRateLimitsWire(self, request: bytes, context):
+        """Raw-bytes twin of GetRateLimits (grpc_api.add_v1_servicer_raw):
+        lets the instance's C++ wire lane run decode→decide→encode
+        without pb2 when the batch qualifies."""
+        with span("grpc.GetRateLimits", metrics=self.instance.metrics):
+            try:
+                return self.instance.get_rate_limits_wire(request)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
     def HealthCheck(self, request: pb.HealthCheckReq, context):
         return health_to_pb(self.instance.health_check())
@@ -159,7 +169,8 @@ class Daemon:
             self.instance.get_rate_limits(
                 [RateLimitRequest(name="_warmup", unique_key="w", hits=0,
                                   limit=1, duration=1000)])
-            add_v1_servicer(self.grpc_server, _V1Servicer(self.instance))
+            add_v1_servicer_raw(self.grpc_server,
+                                _V1Servicer(self.instance))
             add_peers_servicer(self.grpc_server, _PeersServicer(self.instance))
             self.grpc_server.start()
 

@@ -29,11 +29,28 @@ from .types import RateLimitRequest, RateLimitResponse
 log = logging.getLogger("gubernator_tpu.dispatcher")
 
 
+def _job_len(job) -> int:
+    return (len(job.reqs) if isinstance(job, _Job) else len(job.khash))
+
+
 class _Job:
     __slots__ = ("reqs", "now_ms", "future")
 
     def __init__(self, reqs, now_ms):
         self.reqs = reqs
+        self.now_ms = now_ms
+        self.future: Future = Future()
+
+
+class _PackedJob:
+    """Columnar job (C++ wire-ingest lane): a RequestBatch of numpy
+    columns + key hashes instead of RateLimitRequest objects."""
+
+    __slots__ = ("batch", "khash", "now_ms", "future")
+
+    def __init__(self, batch, khash, now_ms):
+        self.batch = batch
+        self.khash = khash
         self.now_ms = now_ms
         self.future: Future = Future()
 
@@ -67,13 +84,23 @@ class Dispatcher:
                     ) -> List[RateLimitResponse]:
         """Submit and wait; concurrent callers share device launches."""
         job = _Job(list(reqs), now_ms)
+        self._submit(job)
+        return job.future.result(timeout=self.RESULT_TIMEOUT_S)
+
+    def check_packed(self, batch, khash, now_ms: int) -> tuple:
+        """Columnar submit (see engine.check_packed); coalesces with
+        other packed callers by column concatenation."""
+        job = _PackedJob(batch, khash, now_ms)
+        self._submit(job)
+        return job.future.result(timeout=self.RESULT_TIMEOUT_S)
+
+    def _submit(self, job) -> None:
         with self._submit_mu:
             # checked under the same lock close() takes, so a job can
             # never slip into the queue after the final drain
             if self._closing.is_set():
                 raise RuntimeError("dispatcher is closed")
             self._queue.put(job)
-        return job.future.result(timeout=self.RESULT_TIMEOUT_S)
 
     # ---- the merge loop -------------------------------------------------
 
@@ -86,7 +113,7 @@ class Dispatcher:
         except queue.Empty:
             return []
         wave = [first]
-        total = len(first.reqs)
+        total = _job_len(first)
         deadline = time.monotonic() + self.max_delay_s
         while total < self.max_wave:
             remain = deadline - time.monotonic()
@@ -96,7 +123,7 @@ class Dispatcher:
             except queue.Empty:
                 break
             wave.append(job)
-            total += len(job.reqs)
+            total += _job_len(job)
         return wave
 
     def _run(self) -> None:
@@ -111,21 +138,54 @@ class Dispatcher:
                 by_now.setdefault(j.now_ms, []).append(j)
             for now in sorted(by_now):
                 jobs = by_now[now]
-                merged: List[RateLimitRequest] = []
-                slices: List[Tuple[_Job, int, int]] = []
-                for j in jobs:
-                    start = len(merged)
-                    merged.extend(j.reqs)
-                    slices.append((j, start, len(merged)))
-                try:
-                    with self._engine_lock:
-                        resps = self.engine.check_batch(merged, now)
-                    for j, a, b in slices:
-                        j.future.set_result(resps[a:b])
-                except Exception as e:  # noqa: BLE001 - surfaced per-caller
-                    for j, _, _ in slices:
-                        if not j.future.done():
-                            j.future.set_exception(e)
+                self._run_list_jobs([j for j in jobs
+                                     if isinstance(j, _Job)], now)
+                self._run_packed_jobs([j for j in jobs
+                                       if isinstance(j, _PackedJob)], now)
+
+    def _run_list_jobs(self, jobs, now) -> None:
+        if not jobs:
+            return
+        merged: List[RateLimitRequest] = []
+        slices: List[Tuple[_Job, int, int]] = []
+        for j in jobs:
+            start = len(merged)
+            merged.extend(j.reqs)
+            slices.append((j, start, len(merged)))
+        try:
+            with self._engine_lock:
+                resps = self.engine.check_batch(merged, now)
+            for j, a, b in slices:
+                j.future.set_result(resps[a:b])
+        except Exception as e:  # noqa: BLE001 - surfaced per-caller
+            for j, _, _ in slices:
+                if not j.future.done():
+                    j.future.set_exception(e)
+
+    def _run_packed_jobs(self, jobs, now) -> None:
+        if not jobs:
+            return
+        import numpy as np
+
+        try:
+            if len(jobs) == 1:
+                batch, khash = jobs[0].batch, jobs[0].khash
+            else:
+                batch = type(jobs[0].batch)(*[
+                    np.concatenate([np.asarray(j.batch[f]) for j in jobs])
+                    for f in range(len(jobs[0].batch))])
+                khash = np.concatenate([j.khash for j in jobs])
+            with self._engine_lock:
+                cols = self.engine.check_packed(batch, khash, now)
+            a = 0
+            for j in jobs:
+                b = a + len(j.khash)
+                j.future.set_result(tuple(c[a:b] for c in cols))
+                a = b
+        except Exception as e:  # noqa: BLE001 - surfaced per-caller
+            for j in jobs:
+                if not j.future.done():
+                    j.future.set_exception(e)
 
     def close(self) -> None:
         with self._submit_mu:

@@ -36,6 +36,25 @@ def add_v1_servicer(server: grpc.Server, servicer) -> None:
         (grpc.method_handlers_generic_handler(V1_SERVICE, handlers),))
 
 
+def add_v1_servicer_raw(server: grpc.Server, servicer) -> None:
+    """Like add_v1_servicer, but GetRateLimits passes request/response as
+    raw serialized bytes (servicer.GetRateLimitsWire(data, ctx) → bytes)
+    so the C++ wire-ingest lane can skip pb2 entirely.  Wire format is
+    unchanged — clients can't tell the difference."""
+    handlers = {
+        "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+            servicer.GetRateLimitsWire,
+            request_deserializer=None,
+            response_serializer=None),
+        "HealthCheck": grpc.unary_unary_rpc_method_handler(
+            servicer.HealthCheck,
+            request_deserializer=pb.HealthCheckReq.FromString,
+            response_serializer=pb.HealthCheckResp.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(V1_SERVICE, handlers),))
+
+
 def add_peers_servicer(server: grpc.Server, servicer) -> None:
     """servicer: object with GetPeerRateLimits / UpdatePeerGlobals."""
     handlers = {

@@ -45,6 +45,11 @@ from .types import (
 
 log = logging.getLogger("gubernator_tpu.instance")
 
+try:  # C++ wire-ingest lane (ops/_native.cpp); optional
+    from .ops import native as _wire_native
+except ImportError:  # pragma: no cover - unbuilt extension
+    _wire_native = None
+
 
 def clock_ms() -> int:
     return time.time_ns() // 1_000_000
@@ -200,6 +205,85 @@ class V1Instance:
         try:
             with self.metrics.time_func("GetRateLimits"):
                 return self._get_rate_limits(reqs, now)
+        finally:
+            self.metrics.concurrent_checks.dec()
+
+    _FAST_EXCLUDED = int(Behavior.GLOBAL) | int(Behavior.MULTI_REGION)
+
+    def get_rate_limits_wire(self, data: bytes,
+                             now_ms: Optional[int] = None) -> bytes:
+        """Wire-to-wire GetRateLimits: serialized GetRateLimitsReq in,
+        serialized GetRateLimitsResp out.
+
+        Takes the C++ columnar fast lane (ops/_native.cpp: wire bytes →
+        packed arrays → one device step → wire bytes, zero per-request
+        Python objects) when the batch qualifies: extension built, no
+        peers, no Store hooks, no GLOBAL/MULTI_REGION behaviors, no
+        metadata, non-empty names/keys.  Anything else falls back to the
+        pb2 object path with identical semantics.  Raises ValueError on
+        oversize batches (mirroring ``get_rate_limits``).
+        """
+        parsed = None
+        if (_wire_native is not None and self.store is None
+                and not self.peers()):
+            parsed = _wire_native.parse_get_rate_limits(data)
+            if parsed is not None and (
+                    parsed["behavior_or"] & self._FAST_EXCLUDED):
+                parsed = None
+        if parsed is None:
+            from google.protobuf.message import DecodeError
+
+            from .wire import req_from_pb, resp_to_pb
+
+            try:
+                msg = pb.GetRateLimitsReq.FromString(data)
+            except DecodeError as e:
+                # surfaced as INVALID_ARGUMENT by the servicer, matching
+                # what a grpc-layer deserializer failure produced before
+                # the raw-bytes handler existed
+                raise ValueError(f"invalid GetRateLimitsReq: {e}") from e
+            reqs = [req_from_pb(m) for m in msg.requests]
+            resps = self.get_rate_limits(reqs, now_ms=now_ms)
+            out = pb.GetRateLimitsResp()
+            out.responses.extend(resp_to_pb(r) for r in resps)
+            return out.SerializeToString()
+        n = parsed["n"]
+        if n > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"Requests.RateLimits list too large; max size is "
+                f"{MAX_BATCH_SIZE}")
+        now = clock_ms() if now_ms is None else now_ms
+        self.metrics.getratelimit_counter.labels(calltype="api").inc(n)
+        self.metrics.concurrent_checks.inc()
+        try:
+            with self.metrics.time_func("GetRateLimits"):
+                from .core.batch import pack_columns
+                from .hashing import mix64_np
+
+                kh = mix64_np(parsed["khash_raw"])
+                kh = np.where(kh == 0, np.uint64(1), kh)
+                batch, errs = pack_columns(
+                    kh, parsed["hits"], parsed["limit"],
+                    parsed["duration"], parsed["algorithm"],
+                    parsed["behavior"], parsed["burst"], now)
+                status, lim, rem, rst, full = self.dispatcher.check_packed(
+                    batch, kh, now)
+                self.metrics.over_limit_counter.inc(
+                    int((status == 1).sum()))
+                errors = None
+                if errs or full.any():
+                    # errored rows already come back zeroed from the
+                    # device (invalid/overfull rows are masked out)
+                    errors = [None] * n
+                    for i, emsg in errs.items():
+                        errors[i] = emsg
+                    for i in np.nonzero(full)[0]:
+                        if errors[int(i)] is None:
+                            errors[int(i)] = "rate limit table full"
+                out_bytes = _wire_native.build_rate_limit_resps(
+                    status, lim, rem, rst, errors)
+                self._maybe_sweep(now)
+                return out_bytes
         finally:
             self.metrics.concurrent_checks.dec()
 

@@ -9,9 +9,20 @@
 // Exposed primitives (wrapped by ops/native.py):
 //   fnv1a64_batch([str|bytes, ...]) -> (bytes, n)   raw FNV-1a 64
 //   fnv1a64_pair_batch(names, keys) -> (bytes, n)   hash(name + "_" + key)
+//   parse_get_rate_limits(bytes) -> None | tuple    wire -> packed columns
+//   build_rate_limit_resps(...) -> bytes            packed columns -> wire
 //
 // The avalanche finalizer stays in Python/numpy (hashing.mix64_np) so
 // there is exactly one source of truth for it.
+//
+// The parse/build pair is the service-path fast lane: a
+// GetRateLimitsReq wire message is decoded straight into fixed-dtype
+// column buffers (key hash, hits, limit, duration, algorithm, behavior,
+// burst) without constructing any per-request Python object, and the
+// response columns from the device step are serialized straight back to
+// a GetRateLimitsResp.  Anything the fast lane doesn't model (metadata,
+// empty name/key, unknown fields) makes parse return None and the
+// caller falls back to the pb2 path — identical behavior, just slower.
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
@@ -120,11 +131,277 @@ static PyObject* fnv1a64_pair_batch(PyObject*, PyObject* args) {
   return Py_BuildValue("(Nn)", out, n);
 }
 
+// ---------------------------------------------------------------------------
+// Protobuf wire fast lane (hand-rolled proto3 varint/length-delimited codec;
+// field numbers from proto/gubernator.proto — the schema is frozen by the
+// reference contract, SURVEY.md §2.4).
+
+// Strict UTF-8 validation (RFC 3629: no surrogates, no overlongs, max
+// U+10FFFF) — mirrors protobuf's string-field check so the fast lane
+// accepts exactly what pb2 accepts.
+static inline bool valid_utf8(const uint8_t* p, uint64_t n) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint8_t c = *p;
+    if (c < 0x80) {
+      p++;
+    } else if ((c & 0xE0) == 0xC0) {
+      if (end - p < 2 || (p[1] & 0xC0) != 0x80 || c < 0xC2) return false;
+      p += 2;
+    } else if ((c & 0xF0) == 0xE0) {
+      if (end - p < 3 || (p[1] & 0xC0) != 0x80 || (p[2] & 0xC0) != 0x80)
+        return false;
+      if (c == 0xE0 && p[1] < 0xA0) return false;          // overlong
+      if (c == 0xED && p[1] >= 0xA0) return false;         // surrogate
+      p += 3;
+    } else if ((c & 0xF8) == 0xF0) {
+      if (end - p < 4 || (p[1] & 0xC0) != 0x80 || (p[2] & 0xC0) != 0x80 ||
+          (p[3] & 0xC0) != 0x80)
+        return false;
+      if (c == 0xF0 && p[1] < 0x90) return false;          // overlong
+      if (c > 0xF4 || (c == 0xF4 && p[1] >= 0x90)) return false;  // >10FFFF
+      p += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+static inline bool read_varint(const uint8_t** p, const uint8_t* end,
+                               uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  const uint8_t* q = *p;
+  while (q < end && shift < 64) {
+    uint8_t b = *q++;
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *p = q;
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// parse_get_rate_limits(bytes) ->
+//   None                                  (needs the pb2 fallback path)
+// | (n, khash_raw u64le, hits i64le, limit i64le, duration i64le,
+//    algorithm i32le, behavior i32le, burst i64le, behavior_or)
+static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  const uint8_t* p = (const uint8_t*)view.buf;
+  const uint8_t* end = p + view.len;
+  std::vector<uint64_t> khash;
+  std::vector<int64_t> hits, limit, duration, burst;
+  std::vector<int32_t> alg, beh;
+  khash.reserve(64);
+  uint64_t beh_or = 0;
+  bool fallback = false;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(&p, end, &tag) || tag != 0x0A) {  // field 1, LEN
+      fallback = true;
+      break;
+    }
+    uint64_t len;
+    if (!read_varint(&p, end, &len) || (uint64_t)(end - p) < len) {
+      fallback = true;
+      break;
+    }
+    const uint8_t* q = p;
+    const uint8_t* qend = p + len;
+    p = qend;
+    const uint8_t* name_p = nullptr;
+    const uint8_t* key_p = nullptr;
+    uint64_t name_len = 0, key_len = 0;
+    int64_t f_hits = 0, f_limit = 0, f_dur = 0, f_burst = 0;
+    int32_t f_alg = 0, f_beh = 0;
+    while (q < qend && !fallback) {
+      uint64_t t;
+      if (!read_varint(&q, qend, &t)) {
+        fallback = true;
+        break;
+      }
+      uint64_t field = t >> 3, wt = t & 7;
+      if (wt == 2) {
+        uint64_t l;
+        if (!read_varint(&q, qend, &l) || (uint64_t)(qend - q) < l) {
+          fallback = true;
+          break;
+        }
+        if (field == 1) {
+          name_p = q;
+          name_len = l;
+        } else if (field == 2) {
+          key_p = q;
+          key_len = l;
+        } else {  // metadata (9) or unknown: not modeled here
+          fallback = true;
+          break;
+        }
+        q += l;
+      } else if (wt == 0) {
+        uint64_t v;
+        if (!read_varint(&q, qend, &v)) {
+          fallback = true;
+          break;
+        }
+        switch (field) {
+          case 3: f_hits = (int64_t)v; break;
+          case 4: f_limit = (int64_t)v; break;
+          case 5: f_dur = (int64_t)v; break;
+          case 6: f_alg = (int32_t)v; break;
+          case 7: f_beh = (int32_t)v; break;
+          case 8: f_burst = (int64_t)v; break;
+          default: fallback = true;
+        }
+      } else {
+        fallback = true;
+      }
+    }
+    if (fallback) break;
+    if (name_p == nullptr || name_len == 0 || key_p == nullptr ||
+        key_len == 0 ||
+        // pb2 rejects invalid UTF-8 in string fields with DecodeError;
+        // the fast lane must not accept what the fallback path rejects
+        !valid_utf8(name_p, name_len) || !valid_utf8(key_p, key_len)) {
+      // empty name/unique_key produce per-request error responses on
+      // the pb2 path; keep that logic in one place
+      fallback = true;
+      break;
+    }
+    uint64_t h = fnv1a64(name_p, (Py_ssize_t)name_len);
+    const unsigned char us = '_';
+    h = fnv1a64(&us, 1, h);
+    h = fnv1a64(key_p, (Py_ssize_t)key_len, h);
+    khash.push_back(h);
+    hits.push_back(f_hits);
+    limit.push_back(f_limit);
+    duration.push_back(f_dur);
+    burst.push_back(f_burst);
+    alg.push_back(f_alg);
+    beh.push_back(f_beh);
+    beh_or |= (uint64_t)(uint32_t)f_beh;
+  }
+  PyBuffer_Release(&view);
+  if (fallback) Py_RETURN_NONE;
+  Py_ssize_t n = (Py_ssize_t)khash.size();
+  // empty vectors may have null data(); Py_BuildValue "y#" would turn
+  // a null pointer into None — hand it a valid empty buffer instead
+  static const char kEmpty[1] = {0};
+  const char* kh_p = n ? (const char*)khash.data() : kEmpty;
+  const char* hi_p = n ? (const char*)hits.data() : kEmpty;
+  const char* li_p = n ? (const char*)limit.data() : kEmpty;
+  const char* du_p = n ? (const char*)duration.data() : kEmpty;
+  const char* al_p = n ? (const char*)alg.data() : kEmpty;
+  const char* be_p = n ? (const char*)beh.data() : kEmpty;
+  const char* bu_p = n ? (const char*)burst.data() : kEmpty;
+  PyObject* out = Py_BuildValue(
+      "(ny#y#y#y#y#y#y#K)", n, kh_p, n * 8, hi_p, n * 8, li_p, n * 8,
+      du_p, n * 8, al_p, n * 4, be_p, n * 4, bu_p, n * 8,
+      (unsigned long long)beh_or);
+  return out;
+}
+
+static inline void put_varint(std::vector<uint8_t>& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back((uint8_t)(v | 0x80));
+    v >>= 7;
+  }
+  out.push_back((uint8_t)v);
+}
+
+static inline void put_field_varint(std::vector<uint8_t>& out, int field,
+                                    uint64_t v) {
+  if (v == 0) return;  // proto3: defaults are omitted
+  put_varint(out, (uint64_t)(field << 3));
+  put_varint(out, v);
+}
+
+// build_rate_limit_resps(status i32le, limit i64le, remaining i64le,
+//                        reset_time i64le, errors|None) -> bytes
+// errors: sequence of str/None per response (None/"" = no error field).
+static PyObject* build_rate_limit_resps(PyObject*, PyObject* args) {
+  Py_buffer st, li, re, rt;
+  PyObject* errors;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*O", &st, &li, &re, &rt, &errors))
+    return nullptr;
+  Py_ssize_t n = st.len / 4;
+  if (li.len != n * 8 || re.len != n * 8 || rt.len != n * 8) {
+    PyBuffer_Release(&st);
+    PyBuffer_Release(&li);
+    PyBuffer_Release(&re);
+    PyBuffer_Release(&rt);
+    PyErr_SetString(PyExc_ValueError, "column length mismatch");
+    return nullptr;
+  }
+  const int32_t* status = (const int32_t*)st.buf;
+  const int64_t* limit = (const int64_t*)li.buf;
+  const int64_t* remaining = (const int64_t*)re.buf;
+  const int64_t* reset_time = (const int64_t*)rt.buf;
+  std::vector<uint8_t> out;
+  out.reserve((size_t)n * 24);
+  std::vector<uint8_t> sub;
+  bool have_errors = errors != Py_None;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    sub.clear();
+    put_field_varint(sub, 1, (uint64_t)(uint32_t)status[i]);
+    put_field_varint(sub, 2, (uint64_t)limit[i]);
+    put_field_varint(sub, 3, (uint64_t)remaining[i]);
+    put_field_varint(sub, 4, (uint64_t)reset_time[i]);
+    if (have_errors) {
+      PyObject* e = PySequence_GetItem(errors, i);
+      if (e == nullptr) {
+        PyBuffer_Release(&st);
+        PyBuffer_Release(&li);
+        PyBuffer_Release(&re);
+        PyBuffer_Release(&rt);
+        return nullptr;
+      }
+      if (e != Py_None) {
+        const unsigned char* ep;
+        Py_ssize_t elen;
+        if (!utf8_view(e, &ep, &elen)) {
+          Py_DECREF(e);
+          PyBuffer_Release(&st);
+          PyBuffer_Release(&li);
+          PyBuffer_Release(&re);
+          PyBuffer_Release(&rt);
+          return nullptr;
+        }
+        if (elen > 0) {
+          put_varint(sub, (5 << 3) | 2);
+          put_varint(sub, (uint64_t)elen);
+          sub.insert(sub.end(), ep, ep + elen);
+        }
+      }
+      Py_DECREF(e);
+    }
+    out.push_back(0x0A);  // GetRateLimitsResp.responses
+    put_varint(out, (uint64_t)sub.size());
+    out.insert(out.end(), sub.begin(), sub.end());
+  }
+  PyBuffer_Release(&st);
+  PyBuffer_Release(&li);
+  PyBuffer_Release(&re);
+  PyBuffer_Release(&rt);
+  return PyBytes_FromStringAndSize((const char*)out.data(),
+                                   (Py_ssize_t)out.size());
+}
+
 static PyMethodDef methods[] = {
     {"fnv1a64_batch", fnv1a64_batch, METH_O,
      "Batch raw FNV-1a64 of str/bytes -> (le64 bytes, n)"},
     {"fnv1a64_pair_batch", fnv1a64_pair_batch, METH_VARARGS,
      "Batch FNV-1a64 of name+'_'+key pairs -> (le64 bytes, n)"},
+    {"parse_get_rate_limits", parse_get_rate_limits, METH_O,
+     "GetRateLimitsReq wire bytes -> packed column buffers (or None)"},
+    {"build_rate_limit_resps", build_rate_limit_resps, METH_VARARGS,
+     "Packed response columns -> GetRateLimitsResp wire bytes"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native",

@@ -23,3 +23,37 @@ def hash_pairs(names: Sequence[str], unique_keys: Sequence[str]) -> np.ndarray:
     """Raw FNV-1a64 of name + "_" + unique_key without string joins."""
     buf, n = _native.fnv1a64_pair_batch(names, unique_keys)
     return np.frombuffer(buf, dtype="<u8", count=n).copy()
+
+
+def parse_get_rate_limits(data: bytes):
+    """GetRateLimitsReq wire bytes → packed column dict, or None when the
+    message needs the pb2 fallback (metadata, empty name/key, unknown
+    fields).  ``khash_raw`` is RAW FNV-1a64 — apply hashing.mix64_np."""
+    r = _native.parse_get_rate_limits(data)
+    if r is None:
+        return None
+    n, kh, hits, limit, dur, alg, beh, burst, beh_or = r
+    return {
+        "n": n,
+        "khash_raw": np.frombuffer(kh, "<u8", count=n),
+        "hits": np.frombuffer(hits, "<i8", count=n),
+        "limit": np.frombuffer(limit, "<i8", count=n),
+        "duration": np.frombuffer(dur, "<i8", count=n),
+        "algorithm": np.frombuffer(alg, "<i4", count=n),
+        "behavior": np.frombuffer(beh, "<i4", count=n),
+        "burst": np.frombuffer(burst, "<i8", count=n),
+        "behavior_or": int(beh_or),
+    }
+
+
+def build_rate_limit_resps(status: np.ndarray, limit: np.ndarray,
+                           remaining: np.ndarray, reset_time: np.ndarray,
+                           errors=None) -> bytes:
+    """Packed response columns → GetRateLimitsResp wire bytes.
+    ``errors``: optional sequence of str/None per response."""
+    return _native.build_rate_limit_resps(
+        np.ascontiguousarray(status, "<i4"),
+        np.ascontiguousarray(limit, "<i8"),
+        np.ascontiguousarray(remaining, "<i8"),
+        np.ascontiguousarray(reset_time, "<i8"),
+        errors if errors is not None else None)

@@ -274,6 +274,74 @@ class ShardedEngine:
             pending = sorted(rest)
         return responses
 
+    def check_packed(self, batch: RequestBatch, khash: np.ndarray,
+                     now_ms: int) -> tuple:
+        """Columnar twin of ``check_batch``: full-length numpy columns in,
+        response columns out — no per-request Python objects (the C++
+        wire-ingest lane).  Returns (status i32[n], limit i64[n],
+        remaining i64[n], reset_time i64[n], table_full bool[n]).
+
+        Invalid rows (batch.valid False) come back zeroed; the caller
+        owns their error strings.  Same wave routing, duplicate-order,
+        and sweep-retry semantics as check_batch.
+        """
+        n = len(khash)
+        status = np.zeros(n, np.int32)
+        rem_o = np.zeros(n, np.int64)
+        rst_o = np.zeros(n, np.int64)
+        lim_o = np.zeros(n, np.int64)
+        full = np.zeros(n, bool)
+        pending = np.arange(n)
+        retried = False
+        while len(pending):
+            shard = shard_of(khash[pending], self.n)
+            order = np.argsort(shard, kind="stable")
+            s_sorted = shard[order]
+            # position within each shard's run → wave id + block slot.
+            # Stable sort keeps request order inside a shard, so same-key
+            # requests stay in original order (sequential parity).
+            starts = np.searchsorted(s_sorted, np.arange(self.n), "left")
+            posin = np.arange(len(pending)) - starts[s_sorted]
+            wave_id = posin // self.B
+            slot = s_sorted.astype(np.int64) * self.B + posin % self.B
+            err_idx: List[int] = []
+            for w in range(int(wave_id.max()) + 1 if len(pending) else 0):
+                m = wave_id == w
+                idx = pending[order[m]]  # original indices
+                slots = slot[m]
+                glob = empty_batch(self.n * self.B)
+                for f in range(len(glob)):
+                    np.asarray(glob[f])[slots] = np.asarray(batch[f])[idx]
+                dev = self._put_batch(glob)
+                self.state, outs, counters = self._step(
+                    self.state, dev, np.int64(now_ms))
+                o_st, o_rem, o_rst, o_lim, o_err = [np.asarray(x)
+                                                    for x in outs]
+                self.over_count += int(counters[0])
+                self.insert_count += int(counters[1])
+                status[idx] = o_st[slots]
+                rem_o[idx] = o_rem[slots]
+                rst_o[idx] = o_rst[slots]
+                lim_o[idx] = o_lim[slots]
+                werr = o_err[slots]
+                if werr.any():
+                    err_idx.extend(idx[werr].tolist())
+            if err_idx and not retried:
+                # probe windows clogged with expired rows: sweep once and
+                # retry those requests (check_batch does the same)
+                retried = True
+                self.sweep(now_ms)
+                pending = np.asarray(sorted(err_idx))
+            else:
+                full[err_idx] = True
+                for i in err_idx:
+                    status[i] = 0
+                    rem_o[i] = 0
+                    rst_o[i] = 0
+                    lim_o[i] = 0
+                pending = np.empty(0, np.int64)
+        return status, lim_o, rem_o, rst_o, full
+
     # ---- row-level access (GLOBAL replication + Store hooks) -----------
 
     def _route_waves(self, khash: np.ndarray):

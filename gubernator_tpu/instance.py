@@ -417,8 +417,9 @@ class V1Instance:
         count toward promotion otherwise.  Returns True when routed."""
         if self.config.hot_set_capacity <= 0:
             return False
-        qualifies = (int(req.algorithm) == int(Algorithm.TOKEN_BUCKET)
-                     and not int(req.behavior) & int(self._HOT_EXCLUDED))
+        # both algorithms qualify (hotset.py merges each natively); only
+        # per-request flags that mutate config/state stay excluded
+        qualifies = not int(req.behavior) & int(self._HOT_EXCLUDED)
         kh = hash_key(req.name, req.unique_key)
         hs = self._hotset
         if hs is not None and hs.is_pinned(kh):

@@ -10,13 +10,16 @@ across the mesh with a single ``lax.psum`` on the sync tick.  Traffic
 per tick is O(hot-set size), independent of request rate — the pod acts
 as one coherent rate-limit region with read-local latency.
 
-Scope (v1, enforced by the host router): TOKEN_BUCKET keys with stable
-(limit, duration) and no RESET/DRAIN/Gregorian flags — the shape of
-real-world hot global limits.  Everything else takes the owner-sharded
-path (parallel/sharded.py), which is already coherent.
+Scope (enforced by the host router): TOKEN_BUCKET or LEAKY_BUCKET keys
+with stable (algorithm, limit, duration, burst) and no
+RESET/DRAIN/Gregorian flags — the shape of real-world hot global
+limits.  Everything else takes the owner-sharded path
+(parallel/sharded.py), which is already coherent.
 
 Merge semantics (per slot, between syncs; replicas start identical at
 ``base``):
+
+TOKEN_BUCKET:
 
 - a replica that saw ``now ≥ expire`` re-created the bucket fresh
   (detected as ``t_i != base.t``); ``any_refresh`` adopts the latest
@@ -25,6 +28,23 @@ Merge semantics (per slot, between syncs; replicas start identical at
   - rem_i``  (≥ 0),
 - merged ``rem = clamp((limit if any_refresh else base.rem) - Σ d_i,
   0, limit)``.
+
+LEAKY_BUCKET (``remaining`` is token-duration fixed point, replenished
+``limit`` per ``eff_ms`` up to ``burst × eff_ms`` — core/table.py): a
+replica's timestamp moves on *every* touch, so refresh detection is
+meaningless; instead consumption is measured against the base
+replenished to the replica's own clock:
+
+- ``rep(t) = min(base.rem + clamp(t - base.t) × limit, burst × eff)``,
+- per-replica consumption ``d_i = max(rep(t_i) - rem_i, 0)``,
+- merged at ``T = pmax(t_i)``: ``rem = clamp(rep(T) - Σ d_i, 0,
+  burst × eff)``.
+
+A replica whose row expired (idle > duration) re-creates it at
+``burst × eff``; ``rep(t_i)`` saturates at the same ceiling by then, so
+the merge needs no special refresh case.  (If ``burst > limit`` and the
+bucket was deeply drained, a refresh can forgive un-replenished debt —
+bounded by one bucket, inside GLOBAL's eventual-consistency contract.)
 
 Within one sync window total admissions across the mesh can exceed the
 limit by at most (n_chips - 1) × per-window consumption — the same
@@ -43,7 +63,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..core.batch import RequestBatch, empty_batch, pack_requests
+from ..core.batch import (MAX_INPUT as _MAXI, RequestBatch,
+                          empty_batch, pack_requests)
 from ..core.step import decide_batch_impl
 from ..core.table import TableState, init_table
 from ..types import RateLimitRequest, RateLimitResponse, Status
@@ -52,6 +73,17 @@ from .mesh import SHARD_AXIS
 
 def _rep(mesh):
     return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def _cfg_of(req: RateLimitRequest) -> tuple:
+    """(alg, limit, duration, burst) exactly as pack_requests clamps them
+    — the pinned row must agree with every packed request that hits it,
+    else the device step would see a config change and reset the row."""
+    alg = 1 if int(req.algorithm) == 1 else 0
+    limit = min(max(int(req.limit), 0), _MAXI)
+    dur = max(min(int(req.duration), _MAXI), 1)
+    burst = min(int(req.burst), _MAXI) if int(req.burst) > 0 else limit
+    return alg, limit, dur, burst
 
 
 def make_hot_step(mesh):
@@ -83,14 +115,32 @@ def make_hot_sync(mesh):
         st = jax.tree.map(lambda x: x[0], state)
         brem, bt = base_rem[0], base_t[0]
         limit = st.limit
-        refreshed = st.t_ms != bt
+        is_leaky = (st.meta & 1) == 1
+        # --- token: refresh detection + consumption vs (refreshed) base
+        refreshed = (~is_leaky) & (st.t_ms != bt)
         any_refresh = lax.pmax(refreshed.astype(jnp.int32), S) > 0
         start = jnp.where(refreshed, limit, brem)
-        d = jnp.maximum(start - st.remaining, 0)
+        d_tok = jnp.maximum(start - st.remaining, 0)
+        # --- leaky: consumption vs base replenished to the replica's t.
+        # elapsed is clamped so elapsed × limit cannot wrap int64 (inputs
+        # are ≤ 2^31 per pack_requests' MAX_INPUT clamp, so cap_td ≤ 2^62
+        # and the clamped product ≤ cap_td + limit).
+        eff = jnp.maximum(st.eff_ms, 1)
+        cap_td = st.burst * eff
+        el_max = cap_td // jnp.maximum(limit, 1) + 1
+
+        def rep_at(t):
+            el = jnp.clip(t - bt, 0, el_max)
+            return jnp.minimum(brem + el * limit, cap_td)
+
+        d_leaky = jnp.maximum(rep_at(st.t_ms) - st.remaining, 0)
+        d = jnp.where(is_leaky, d_leaky, d_tok)
         total = lax.psum(d, S)
-        merged_base = jnp.where(any_refresh, limit, brem)
-        new_rem = jnp.clip(merged_base - total, 0, limit)
         new_t = lax.pmax(st.t_ms, S)
+        merged_base = jnp.where(any_refresh, limit, brem)
+        new_rem_tok = jnp.clip(merged_base - total, 0, limit)
+        new_rem_leaky = jnp.clip(rep_at(new_t) - total, 0, cap_td)
+        new_rem = jnp.where(is_leaky, new_rem_leaky, new_rem_tok)
         new_exp = lax.pmax(st.expire_at, S)
         st = st._replace(remaining=new_rem, t_ms=new_t, expire_at=new_exp)
         out_state = jax.tree.map(lambda x: x[None], st)
@@ -117,7 +167,8 @@ class HotSetEngine:
         self.capacity = capacity
         self.B = batch_per_chip
         self.slots: Dict[int, int] = {}  # key_hash → slot
-        self.pinned_cfg: Dict[int, tuple] = {}  # key_hash → (limit, duration)
+        #: key_hash → (alg, limit, duration, burst) — see _cfg_of
+        self.pinned_cfg: Dict[int, tuple] = {}
         #: Demoted keys keep their slot reserved (and their device row in
         #: place): clearing the key column would let an in-flight hot
         #: request re-insert a phantom fresh bucket, and re-pinning at a
@@ -190,15 +241,16 @@ class HotSetEngine:
                 else:
                     self._occupied.add(slot)
             self.slots[key_hash] = slot
-            self.pinned_cfg[key_hash] = (max(int(req.limit), 0),
-                                         max(int(req.duration), 1))
-        limit = max(int(req.limit), 0)
-        dur = max(int(req.duration), 1)
+            self.pinned_cfg[key_hash] = _cfg_of(req)
+        alg, limit, dur, burst = _cfg_of(req)
+        # fresh leaky buckets start at burst × eff token-duration fixed
+        # point; token buckets at limit (core/step.py › rem_fresh)
+        rem0 = burst * dur if alg else limit
         host = {
-            "key": np.uint64(key_hash), "meta": np.int32(0),
+            "key": np.uint64(key_hash), "meta": np.int32(alg),
             "limit": np.int64(limit), "duration": np.int64(dur),
-            "eff_ms": np.int64(dur), "burst": np.int64(limit),
-            "remaining": np.int64(limit), "t_ms": np.int64(now_ms),
+            "eff_ms": np.int64(dur), "burst": np.int64(burst),
+            "remaining": np.int64(rem0), "t_ms": np.int64(now_ms),
             "expire_at": np.int64(now_ms + dur),
         }
         if seed is not None:
@@ -224,8 +276,7 @@ class HotSetEngine:
         return key_hash in self.slots
 
     def matches_pinned(self, key_hash: int, req: RateLimitRequest) -> bool:
-        cfg = self.pinned_cfg.get(key_hash)
-        return cfg == (max(int(req.limit), 0), max(int(req.duration), 1))
+        return self.pinned_cfg.get(key_hash) == _cfg_of(req)
 
     def row_state(self, key_hash: int) -> Optional[dict]:
         """Merged row values for a pinned key (call ``sync()`` first —

@@ -98,6 +98,87 @@ def test_expiry_refresh_merges(mesh4):
     assert rs[0].remaining == 20 - 8
 
 
+def lreq(key="lk", limit=1000, hits=1, duration=60_000, burst=0):
+    from gubernator_tpu.types import Algorithm
+
+    return RateLimitRequest(name="hot", unique_key=key, hits=hits,
+                            limit=limit, duration=duration, burst=burst,
+                            algorithm=Algorithm.LEAKY_BUCKET)
+
+
+def test_leaky_pin_and_serve(mesh4):
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    assert eng.pin(lreq(), kh("lk"), NOW)
+    r = eng.check_batch([lreq(hits=3)], [kh("lk")], NOW + 1)[0]
+    assert r.error == ""
+    assert (int(r.status), r.remaining) == (0, 997)
+
+
+def test_leaky_replicas_diverge_then_psum_converges(mesh4):
+    """Leaky consumption folds across replicas like token consumption;
+    the merge measures each replica against the replenished base."""
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    eng.pin(lreq("lc"), kh("lc"), NOW)
+    rs = eng.check_batch([lreq("lc") for _ in range(40)],
+                         [kh("lc")] * 40, NOW + 1)
+    assert all(r.status == Status.UNDER_LIMIT for r in rs)
+    # pre-sync each replica only saw its own share
+    assert min(r.remaining for r in rs) >= 1000 - 40 // eng.n - 1
+    eng.sync()
+    rs = eng.check_batch([lreq("lc", hits=0) for _ in range(eng.n)],
+                         [kh("lc")] * eng.n, NOW + 2)
+    # 1ms of replenish at 1000/60s is < 1 token: floor stays at 960
+    assert {r.remaining for r in rs} == {960}
+
+
+def test_leaky_conservation_across_syncs(mesh4):
+    """Sync after every wave ⇒ exactly burst admissions while replenish
+    rounds to zero tokens."""
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    eng.pin(lreq("lcons", limit=50), kh("lcons"), NOW)
+    admitted = 0
+    for wave in range(10):
+        rs = eng.check_batch([lreq("lcons", limit=50) for _ in range(10)],
+                             [kh("lcons")] * 10, NOW + wave)
+        admitted += sum(1 for r in rs if r.status == Status.UNDER_LIMIT)
+        eng.sync()
+    assert admitted == 50
+    rs = eng.check_batch([lreq("lcons", limit=50, hits=0)], [kh("lcons")],
+                         NOW + 100)
+    assert rs[0].remaining == 0
+
+
+def test_leaky_replenish_after_merged_drain(mesh4):
+    """Post-sync the merged bucket leaks at limit/duration: half the
+    duration replenishes half the limit."""
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=64)
+    eng.pin(lreq("lr", limit=100, duration=1_000), kh("lr"), NOW)
+    rs = eng.check_batch([lreq("lr", limit=100, duration=1_000)] * 100,
+                         [kh("lr")] * 100, NOW + 1)
+    assert all(r.status == Status.UNDER_LIMIT for r in rs)
+    eng.sync()
+    rs = eng.check_batch([lreq("lr", limit=100, duration=1_000, hits=0)],
+                         [kh("lr")], NOW + 1)
+    assert rs[0].remaining == 0  # fold drained the shared bucket
+    rs = eng.check_batch([lreq("lr", limit=100, duration=1_000, hits=0)],
+                         [kh("lr")], NOW + 501)
+    assert rs[0].remaining == 50  # 500 ms × (100 per 1000 ms)
+
+
+def test_mixed_algorithms_one_sync(mesh4):
+    """Token and leaky rows coexist; one psum folds both correctly."""
+    eng = HotSetEngine(mesh4, capacity=256, batch_per_chip=32)
+    eng.pin(req("mt", limit=500), kh("mt"), NOW)
+    eng.pin(lreq("ml"), kh("ml"), NOW)
+    eng.check_batch([req("mt", limit=500)] * 20 + [lreq("ml")] * 20,
+                    [kh("mt")] * 20 + [kh("ml")] * 20, NOW + 1)
+    eng.sync()
+    rs = eng.check_batch([req("mt", limit=500, hits=0), lreq("ml", hits=0)],
+                         [kh("mt"), kh("ml")], NOW + 2)
+    assert rs[0].remaining == 480
+    assert rs[1].remaining == 980
+
+
 def test_probe_window_exhaustion():
     mesh = make_mesh(n=2)
     eng = HotSetEngine(mesh, capacity=8, batch_per_chip=8)

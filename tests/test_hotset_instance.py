@@ -55,17 +55,50 @@ def test_promotion_and_convergence():
         inst.close()
 
 
-def test_non_token_and_flagged_requests_bypass_hot_set():
+def test_flagged_requests_bypass_hot_set():
     inst = mk_instance(threshold=1)
     try:
-        inst.get_rate_limits(
-            [req(key="leaky", algorithm=Algorithm.LEAKY_BUCKET)], now_ms=NOW)
         inst.get_rate_limits(
             [req(key="flg",
                  behavior=Behavior.GLOBAL | Behavior.RESET_REMAINING)],
             now_ms=NOW)
         hs = inst._hotset
         assert hs is None or len(hs.slots) == 0
+    finally:
+        inst.close()
+
+
+def test_leaky_promotes_and_demotes_preserving_consumption():
+    """LEAKY_BUCKET GLOBAL keys ride the psum tier too; consumption
+    survives promote → hot serving → demote."""
+    from gubernator_tpu.hashing import hash_key
+    from gubernator_tpu.types import PeerInfo
+
+    inst = mk_instance(threshold=1)
+    try:
+        kh = hash_key("hotinst", "lk")
+
+        def lr(hits=1):
+            return req(key="lk", hits=hits, limit=1000,
+                       duration=600_000, algorithm=Algorithm.LEAKY_BUCKET)
+
+        inst.get_rate_limits([lr()], now_ms=NOW)  # promotes
+        assert inst._hotset is not None and inst._hotset.is_pinned(kh)
+        rs = inst.get_rate_limits([lr() for _ in range(10)], now_ms=NOW + 1)
+        assert all(r.status == Status.UNDER_LIMIT and r.error == ""
+                   for r in rs)
+        # peers joining demotes; the merged leaky row lands in the table
+        inst.set_peers([PeerInfo(grpc_address="127.0.0.1:1"),
+                        PeerInfo(grpc_address="127.0.0.1:2")])
+        assert not inst._hotset.is_pinned(kh)
+        import numpy as np
+
+        found, cols = inst.engine.gather_rows(np.array([kh], np.uint64))
+        assert found[0]
+        assert int(cols["meta"][0]) & 1 == 1  # still a leaky row
+        # 11 hits of 600_000 td each against burst 1000×600_000;
+        # ≤ 1 ms of replenish (1000/600s) rounds to 0 whole tokens
+        assert int(cols["remaining"][0]) // 600_000 == 1000 - 11
     finally:
         inst.close()
 

@@ -32,7 +32,10 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-CAP = 1 << 21          # 2M rows for 1M keys (load factor 0.5)
+#: 2M rows for 1M keys (load factor 0.5); GUBER_BENCH_CAP overrides for
+#: capacity sweeps (table streaming is the per-step cost floor: the
+#: no-donation step copies the whole SoA table each launch)
+CAP = int(os.environ.get("GUBER_BENCH_CAP", 1 << 21))
 #: device batch = coalesced client batches of 1024 (GUBER_BENCH_B overrides
 #: for batch-size sweeps; GUBER_BENCH_FAST=1 shrinks the program for
 #: cold-compile-constrained runs)
@@ -346,11 +349,20 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
         out["7_hot_psum"] = {"error": str(e)[:200]}
 
     # -- config 5: huge multi-tenant table, Gregorian resets +
-    # RESET_REMAINING churn.  Capacity scaled to HBM (~72 B/row).
+    # RESET_REMAINING churn.  Capacity sized to the chip's memory
+    # budget: ~72 B/row and the no-donation step keeps TWO copies of
+    # the table live (input + streamed output), so pick the largest
+    # power of two with 2 × cap × 72 B within ~80% of HBM.
     try:
-        cap5 = 1 << 27  # 134M rows ≈ 9.7 GB
         if jax.default_backend() == "cpu":
             cap5 = 1 << 22
+        else:
+            try:
+                budget = jax.devices()[0].memory_stats()["bytes_limit"]
+            except Exception:  # noqa: BLE001 - stats not exposed
+                budget = 12 << 30  # conservative v5e-class default
+            cap5 = 1 << int(np.log2(budget * 0.8 / (2 * 72)))
+            cap5 = min(cap5, 1 << 27)
         n_keys5 = int(cap5 * 0.75)
         st5 = init_table(cap5)
         greg_end = gregorian_expiration(NOW0, int(GregorianDuration.HOURS))

@@ -52,7 +52,9 @@ def _sweep_kernel(now_ref, khi_ref, klo_ref, ehi_ref, elo_ref,
 
     @pl.when(i == 0)
     def _init():
-        live_ref[0] = 0
+        # pinned dtype: a bare 0 is weakly-typed and becomes an i64
+        # constant under x64, which Mosaic refuses to store/return
+        live_ref[0] = jnp.int32(0)
 
     now_hi, now_lo = now_ref[0], now_ref[1]
     ehi, elo = ehi_ref[:], elo_ref[:]
@@ -60,16 +62,24 @@ def _sweep_kernel(now_ref, khi_ref, klo_ref, ehi_ref, elo_ref,
     # (lo words are reinterpreted-int32; flipping the sign bit makes
     # int32 compare order match the unsigned order.)
     flip = jnp.int32(-2147483648)
-    dead = (ehi < now_hi) | ((ehi == now_hi) & (elo ^ flip <= now_lo ^ flip))
+    expired = (ehi < now_hi) | ((ehi == now_hi) &
+                                (elo ^ flip <= now_lo ^ flip))
     khi, klo = khi_ref[:], klo_ref[:]
     empty = (khi == 0) & (klo == 0)
-    dead = dead | empty
     zero = jnp.zeros_like(khi)
-    khi_out[:] = jnp.where(dead, zero, khi)
-    klo_out[:] = jnp.where(dead, zero, klo)
-    ehi_out[:] = jnp.where(dead, zero, ehi)
-    elo_out[:] = jnp.where(dead, zero, elo)
-    live_ref[0] += jnp.sum((~dead).astype(jnp.int32))
+    # zero exactly what sweep_expired zeroes (expired rows only — an
+    # empty row's stale expire_at is never read, and bit-equality with
+    # the XLA sweep is what the parity tests assert)
+    khi_out[:] = jnp.where(expired, zero, khi)
+    klo_out[:] = jnp.where(expired, zero, klo)
+    ehi_out[:] = jnp.where(expired, zero, ehi)
+    elo_out[:] = jnp.where(expired, zero, elo)
+    # count in float32: with x64 enabled, jnp.sum on int32 routes through
+    # an int64 accumulator (numpy promotion) even when dtype=int32 is
+    # passed, and Mosaic cannot lower 64-bit; f32 is promotion-stable and
+    # exact here (a tile holds BLK×LANES = 1024 ≪ 2^24 elements)
+    live = ~(expired | empty)
+    live_ref[0] += jnp.sum(live.astype(jnp.float32)).astype(jnp.int32)
 
 
 def _sweep_2d(khi, klo, ehi, elo, now_hi_lo, *, interpret: bool):
@@ -78,19 +88,23 @@ def _sweep_2d(khi, klo, ehi, elo, now_hi_lo, *, interpret: bool):
     tile = pl.BlockSpec((BLK, LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((rows, LANES), jnp.int32)
-    return pl.pallas_call(
-        _sweep_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # now (2,) scalar
-            tile, tile, tile, tile,
-        ],
-        out_specs=[tile, tile, tile, tile,
-                   pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_shape=[out_shape, out_shape, out_shape, out_shape,
-                   jax.ShapeDtypeStruct((1,), jnp.int32)],
-        interpret=interpret,
-    )(now_hi_lo, khi, klo, ehi, elo)
+    # x64 off while tracing the kernel: every operand is already int32,
+    # but under x64 the BlockSpec index_map's literals trace as i64
+    # scalars and Mosaic fails to legalize the index function's return
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _sweep_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # now (2,) scalar
+                tile, tile, tile, tile,
+            ],
+            out_specs=[tile, tile, tile, tile,
+                       pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_shape=[out_shape, out_shape, out_shape, out_shape,
+                       jax.ShapeDtypeStruct((1,), jnp.int32)],
+            interpret=interpret,
+        )(now_hi_lo, khi, klo, ehi, elo)
 
 
 @partial(jax.jit, static_argnames=("interpret",))

@@ -110,6 +110,13 @@ def make_hot_sync(mesh):
     """The psum fold: merge per-replica consumption into a new common
     base — the entire global.go subsystem as one collective."""
     S = SHARD_AXIS
+    n = mesh.shape[S]
+    # On a singleton mesh the collectives are identities; eliding them
+    # matters beyond speed: axon's chipless AOT compile helper crashes
+    # on this program's psum/pmax at topology v5e:1x1x1 (observed
+    # 2026-07-30), and a 1-chip hot set must still work there.
+    psum = (lambda x: lax.psum(x, S)) if n > 1 else (lambda x: x)
+    pmax = (lambda x: lax.pmax(x, S)) if n > 1 else (lambda x: x)
 
     def _sync(state, base_rem, base_t):
         st = jax.tree.map(lambda x: x[0], state)
@@ -118,7 +125,7 @@ def make_hot_sync(mesh):
         is_leaky = (st.meta & 1) == 1
         # --- token: refresh detection + consumption vs (refreshed) base
         refreshed = (~is_leaky) & (st.t_ms != bt)
-        any_refresh = lax.pmax(refreshed.astype(jnp.int32), S) > 0
+        any_refresh = pmax(refreshed.astype(jnp.int32)) > 0
         start = jnp.where(refreshed, limit, brem)
         d_tok = jnp.maximum(start - st.remaining, 0)
         # --- leaky: consumption vs base replenished to the replica's t.
@@ -135,13 +142,13 @@ def make_hot_sync(mesh):
 
         d_leaky = jnp.maximum(rep_at(st.t_ms) - st.remaining, 0)
         d = jnp.where(is_leaky, d_leaky, d_tok)
-        total = lax.psum(d, S)
-        new_t = lax.pmax(st.t_ms, S)
+        total = psum(d)
+        new_t = pmax(st.t_ms)
         merged_base = jnp.where(any_refresh, limit, brem)
         new_rem_tok = jnp.clip(merged_base - total, 0, limit)
         new_rem_leaky = jnp.clip(rep_at(new_t) - total, 0, cap_td)
         new_rem = jnp.where(is_leaky, new_rem_leaky, new_rem_tok)
-        new_exp = lax.pmax(st.expire_at, S)
+        new_exp = pmax(st.expire_at)
         st = st._replace(remaining=new_rem, t_ms=new_t, expire_at=new_exp)
         out_state = jax.tree.map(lambda x: x[None], st)
         return out_state, new_rem[None], new_t[None]

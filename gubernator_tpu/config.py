@@ -96,6 +96,11 @@ class Config:
     cache_size: int = 1 << 16
     #: Device batch rows per shard per step.
     batch_rows: int = 1024
+    #: Upper bound (total rows) for on-device capacity auto-grow when
+    #: the table fills with LIVE keys (0 disables; the reference's LRU
+    #: never fails an insert, so enabling this matches that contract up
+    #: to the bound).  Rounded to a power of two per shard.
+    cache_autogrow_max: int = 0
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     #: This node's datacenter name (multi-region routing).
     data_center: str = ""
@@ -152,6 +157,7 @@ class DaemonConfig:
     http_listen_address: str = "localhost:1050"
     advertise_address: str = ""
     cache_size: int = 1 << 16
+    cache_autogrow_max: int = 0
     data_center: str = ""
     instance_id: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -184,6 +190,7 @@ class DaemonConfig:
     def instance_config(self) -> Config:
         return Config(
             cache_size=self.cache_size,
+            cache_autogrow_max=self.cache_autogrow_max,
             behaviors=self.behaviors,
             data_center=self.data_center,
             advertise_address=self.advertise_address or self.grpc_listen_address,
@@ -253,6 +260,8 @@ def setup_daemon_config(conf_file: str = "",
     d.http_listen_address = src.get("GUBER_HTTP_ADDRESS", d.http_listen_address)
     d.advertise_address = src.get("GUBER_ADVERTISE_ADDRESS", d.advertise_address)
     d.cache_size = src.get("GUBER_CACHE_SIZE", d.cache_size, int)
+    d.cache_autogrow_max = src.get("GUBER_CACHE_AUTOGROW_MAX",
+                                   d.cache_autogrow_max, int)
     d.data_center = src.get("GUBER_DATA_CENTER", d.data_center)
     d.instance_id = src.get("GUBER_INSTANCE_ID", d.instance_id)
     d.log_level = src.get("GUBER_LOG_LEVEL", d.log_level)

@@ -12,6 +12,7 @@ on exactly one shard, so owner-applies-hits parity is exact.
 """
 from __future__ import annotations
 
+import logging
 from typing import List, Sequence
 
 import jax
@@ -27,6 +28,8 @@ from ..core.batch import RequestBatch, empty_batch, pack_requests
 from ..core.step import decide_batch_impl, _insert, _lookup, _probe_slots
 from ..core.table import TableState
 from .mesh import SHARD_AXIS, make_mesh, shard_table, table_sharding
+
+log = logging.getLogger("gubernator_tpu.sharded")
 
 #: TableState value columns addressable by row programs (all but `key`).
 VALUE_COLS = tuple(f for f in TableState._fields if f != "key")
@@ -98,6 +101,56 @@ def make_upsert_rows(mesh):
     return jax.jit(sharded)
 
 
+def make_grow(mesh, cap_new: int):
+    """jit program: re-place every live row of a [cap_old] shard table
+    into a fresh [cap_new] table, entirely on device — the reshard path
+    for capacity changes (ROUND_NOTES gap: the host-mediated
+    snapshot/restore loop is shard-count independent but streams the
+    whole table through host memory; this is one device program).
+
+    Key→shard ownership depends only on the mesh size (hashing.shard_of),
+    so capacity changes never move rows across shards: the program is a
+    per-shard probe re-insertion plus a psum'd dropped-row count (rows
+    whose probe window in the target is exhausted — common when
+    shrinking into high occupancy, rare but possible even when growing
+    from a full table; best-effort like restore, and callers surface
+    the count: a dropped key resets, which is inside the reference's
+    LRU-eviction contract but must be observable).
+    """
+
+    def _grow(state):
+        cap_old = state.key.shape[0]
+        key = state.key
+        valid = key != 0
+        slots = _probe_slots(key, cap_new)
+        tkey, row, _ = _insert(jnp.zeros(cap_new, jnp.uint64), slots, key,
+                               valid, jnp.full(cap_old, -1, jnp.int32))
+        placed = valid & (row >= 0)
+        wrow = jnp.where(placed, row, cap_new)
+        fresh = init_table_like(cap_new, state)
+        new = {"key": tkey}
+        for f in VALUE_COLS:
+            new[f] = getattr(fresh, f).at[wrow].set(getattr(state, f),
+                                                    mode="drop")
+        dropped = lax.psum((valid & (~placed)).sum(dtype=jnp.int64),
+                           SHARD_AXIS)
+        return TableState(**new), dropped
+
+    return jax.jit(shard_map(
+        _grow, mesh=mesh, in_specs=P(SHARD_AXIS),
+        out_specs=(P(SHARD_AXIS), P())))
+
+
+def init_table_like(capacity: int, state: TableState) -> TableState:
+    """Empty per-shard table (shard_map-safe: init_table does no device
+    placement and its guards are host-side trace-time checks, so there
+    is exactly one source of truth for column defaults)."""
+    del state  # dtypes are init_table's to define
+    from ..core.table import init_table
+
+    return init_table(capacity)
+
+
 def make_sharded_step(mesh):
     """jit-compiled sharded step: (state, batch, now) → (state, outputs).
 
@@ -131,11 +184,17 @@ class ShardedEngine:
     GetRateLimits → picker.Get → local/forward split)."""
 
     def __init__(self, mesh=None, capacity_per_shard: int = 1 << 16,
-                 batch_per_shard: int = 1024):
+                 batch_per_shard: int = 1024,
+                 auto_grow_limit: int = 0):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n = self.mesh.shape[SHARD_AXIS]
         self.cap_local = capacity_per_shard
         self.B = batch_per_shard
+        #: per-shard capacity ceiling for on-device auto-grow when probe
+        #: windows stay exhausted after a sweep (0 = disabled).  The
+        #: reference's LRU never fails an insert; with auto-grow on,
+        #: neither do we until this bound.
+        self.auto_grow_limit = auto_grow_limit
         self.state = shard_table(self.mesh, capacity_per_shard)
         self._step = make_sharded_step(self.mesh)
         self._batch_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
@@ -148,6 +207,8 @@ class ShardedEngine:
         self._upsert = None
         self._remove = None
         self._pallas_sweep_fn = None
+        self._grow_fns: dict = {}  # cap_new → compiled grow program
+        self.dropped_rows = 0  # rows lost to grow/restore re-placement
 
     def sweep(self, now_ms: int) -> None:
         """Reclaim expired rows on every shard (elementwise on the
@@ -213,6 +274,7 @@ class ShardedEngine:
             wave_pos: List[int] = []  # block slot, assigned at admission
             fill = [0] * self.n
             rest: List[int] = []
+            grew = [False]  # at most one capacity doubling per wave
             for i in pending:
                 s = int(shard[i])
                 if fill[s] < self.B:
@@ -255,6 +317,10 @@ class ShardedEngine:
                         if not swept:
                             self.sweep(now_ms)
                             swept = True
+                    elif self._try_auto_grow(grew):
+                        # retry at the doubled capacity; terminates when
+                        # cap reaches auto_grow_limit (growth is strict)
+                        rest.append(i)
                     else:
                         responses[i] = RateLimitResponse(
                             error="rate limit table full")
@@ -332,6 +398,8 @@ class ShardedEngine:
                 retried = True
                 self.sweep(now_ms)
                 pending = np.asarray(sorted(err_idx))
+            elif err_idx and self._try_auto_grow([False]):
+                pending = np.asarray(sorted(err_idx))
             else:
                 full[err_idx] = True
                 for i in err_idx:
@@ -341,6 +409,41 @@ class ShardedEngine:
                     lim_o[i] = 0
                 pending = np.empty(0, np.int64)
         return status, lim_o, rem_o, rst_o, full
+
+    def _try_auto_grow(self, grew: list) -> bool:
+        """Grow 2× (once per wave) if under auto_grow_limit.  Returns
+        True when the caller should retry at the larger capacity."""
+        if not self.auto_grow_limit \
+                or self.cap_local * 2 > self.auto_grow_limit:
+            return False
+        if not grew[0]:
+            dropped = self.grow(self.cap_local * 2)
+            if dropped:
+                # a dropped row is a silent counter reset — allowed by
+                # the LRU-eviction contract, never allowed to be quiet
+                log.warning("auto-grow to %d/shard dropped %d live rows "
+                            "(probe-window exhaustion)",
+                            self.cap_local, dropped)
+            grew[0] = True
+        return True
+
+    def grow(self, new_cap_per_shard: int) -> int:
+        """Re-place all live rows into a [new_cap_per_shard] table on
+        device (see make_grow).  Returns the dropped-row count (non-zero
+        only when shrinking into high occupancy).  Subsequent step/row
+        programs recompile automatically for the new shape."""
+        if new_cap_per_shard & (new_cap_per_shard - 1) \
+                or new_cap_per_shard <= 0:
+            raise ValueError(
+                f"capacity must be a power of two, got {new_cap_per_shard}")
+        fn = self._grow_fns.get(new_cap_per_shard)
+        if fn is None:
+            fn = make_grow(self.mesh, new_cap_per_shard)
+            self._grow_fns[new_cap_per_shard] = fn
+        self.state, dropped = fn(self.state)
+        self.cap_local = new_cap_per_shard
+        self.dropped_rows += int(dropped)
+        return int(dropped)
 
     # ---- row-level access (GLOBAL replication + Store hooks) -----------
 

@@ -70,6 +70,87 @@ class TestShardedEngine:
         assert [r.remaining for r in got] == list(range(999, 849, -1))
 
 
+class TestOnDeviceGrow:
+    def test_grow_preserves_every_row(self):
+        eng = ShardedEngine(make_mesh(n=4), capacity_per_shard=1 << 9,
+                            batch_per_shard=64)
+        reqs = [mk(f"g{i}", limit=100) for i in range(600)]
+        eng.check_batch(reqs, NOW)
+        eng.check_batch(reqs[:200], NOW + 1)  # consume extra on some keys
+        from gubernator_tpu.hashing import hash_request_keys
+
+        khash = hash_request_keys(["shard"] * 600,
+                                  [f"g{i}" for i in range(600)])
+        found0, cols0 = eng.gather_rows(khash)
+        assert found0.all()
+        dropped = eng.grow(1 << 11)
+        assert dropped == 0
+        assert eng.cap_local == 1 << 11
+        found1, cols1 = eng.gather_rows(khash)
+        assert found1.all()
+        for f in cols0:
+            assert (cols0[f] == cols1[f]).all(), f
+        # decisions continue against the migrated state
+        got = eng.check_batch(reqs[:200], NOW + 2)
+        assert [r.remaining for r in got] == [97] * 200
+
+    def test_shrink_reports_drops_best_effort(self):
+        eng = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
+                            batch_per_shard=64)
+        reqs = [mk(f"s{i}") for i in range(700)]
+        got = eng.check_batch(reqs, NOW)
+        live = sum(1 for r in got if not r.error)
+        dropped = eng.grow(1 << 6)  # 128 slots total for ~700 keys
+        assert dropped > 0
+        from gubernator_tpu.core.table import occupancy
+
+        assert int(occupancy(eng.state)) == live - dropped
+        # surviving rows still serve correct decisions
+        got2 = eng.check_batch(reqs, NOW + 1)
+        assert any(not r.error and r.remaining == 8 for r in got2)
+
+    def test_auto_grow_on_live_key_pressure(self):
+        # tiny table + live keys only: without auto-grow this returns
+        # "rate limit table full"; with it, capacity doubles on device
+        # and every insert succeeds (the reference's LRU contract)
+        eng = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 6,
+                            batch_per_shard=64,
+                            auto_grow_limit=1 << 12)
+        reqs = [mk(f"ag{i}", duration=10**7) for i in range(400)]
+        got = eng.check_batch(reqs, NOW)
+        assert all(r.error == "" for r in got)
+        assert eng.cap_local > 1 << 6
+        # and the packed lane takes the same path
+        eng2 = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 6,
+                             batch_per_shard=64,
+                             auto_grow_limit=1 << 12)
+        from gubernator_tpu.core.batch import pack_columns
+        from gubernator_tpu.hashing import hash_request_keys
+        import numpy as np
+
+        kh = hash_request_keys(["shard"] * 400,
+                               [f"ag{i}" for i in range(400)])
+        batch, errs = pack_columns(
+            kh, np.ones(400, np.int64), np.full(400, 10, np.int64),
+            np.full(400, 10**7, np.int64), np.zeros(400, np.int32),
+            np.zeros(400, np.int32), np.zeros(400, np.int64), NOW)
+        assert not errs
+        _, _, _, _, full = eng2.check_packed(batch, kh, NOW)
+        assert not full.any()
+        assert eng2.cap_local > 1 << 6
+
+    def test_grow_is_device_resident(self):
+        # the whole point: no host column staging — state stays sharded
+        eng = ShardedEngine(make_mesh(n=4), capacity_per_shard=1 << 8,
+                            batch_per_shard=32)
+        eng.check_batch([mk(f"d{i}") for i in range(100)], NOW)
+        eng.grow(1 << 10)
+        from jax.sharding import PartitionSpec as P
+
+        assert eng.state.key.sharding.spec == P("shard")
+        assert eng.state.key.shape[0] == 4 * (1 << 10)
+
+
 def test_graft_entry_single():
     import __graft_entry__ as ge
     import jax

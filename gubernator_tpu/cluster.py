@@ -97,14 +97,12 @@ def start_with(cfgs: List[DaemonConfig], mesh=None,
         n_dev = mesh.shape["shard"]
         cap_local = max(cfg.cache_size // n_dev, 256)
         cap_local = 1 << (cap_local - 1).bit_length()
-        agl = 0
-        if cfg.cache_autogrow_max > 0:
-            # same rounding as V1Instance: an upper bound rounds DOWN
-            agl = max(cfg.cache_autogrow_max // n_dev, cap_local)
-            agl = 1 << (agl.bit_length() - 1)
-        engine = ShardedEngine(mesh, capacity_per_shard=cap_local,
-                               batch_per_shard=batch_rows,
-                               auto_grow_limit=agl)
+        from .parallel.sharded import autogrow_limit_per_shard
+
+        engine = ShardedEngine(
+            mesh, capacity_per_shard=cap_local, batch_per_shard=batch_rows,
+            auto_grow_limit=autogrow_limit_per_shard(
+                cfg.cache_autogrow_max, n_dev, cap_local))
         daemons.append(spawn_daemon(cfg, mesh=mesh, engine=engine))
     infos = [d.peer_info() for d in daemons]
     for d in daemons:

@@ -69,15 +69,13 @@ class V1Instance:
             n = m.shape["shard"]
             cap_local = max(config.cache_size // n, 1024)
             cap_local = 1 << (cap_local - 1).bit_length()
-            agl = 0
-            if config.cache_autogrow_max > 0:
-                # rounded DOWN: the knob documents an upper bound, and a
-                # memory-budgeted deployment must never exceed it
-                agl = max(config.cache_autogrow_max // n, cap_local)
-                agl = 1 << (agl.bit_length() - 1)
-            engine = ShardedEngine(m, capacity_per_shard=cap_local,
-                                   batch_per_shard=config.batch_rows,
-                                   auto_grow_limit=agl)
+            from .parallel.sharded import autogrow_limit_per_shard
+
+            engine = ShardedEngine(
+                m, capacity_per_shard=cap_local,
+                batch_per_shard=config.batch_rows,
+                auto_grow_limit=autogrow_limit_per_shard(
+                    config.cache_autogrow_max, n, cap_local))
         self.engine = engine
         self._engine_mu = threading.Lock()
         from .dispatcher import Dispatcher

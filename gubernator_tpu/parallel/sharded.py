@@ -26,13 +26,25 @@ from ..hashing import shard_of
 from ..types import RateLimitRequest, RateLimitResponse, Status
 from ..core.batch import RequestBatch, empty_batch, pack_requests
 from ..core.step import decide_batch_impl, _insert, _lookup, _probe_slots
-from ..core.table import TableState
+from ..core.table import TableState, init_table
 from .mesh import SHARD_AXIS, make_mesh, shard_table, table_sharding
 
 log = logging.getLogger("gubernator_tpu.sharded")
 
 #: TableState value columns addressable by row programs (all but `key`).
 VALUE_COLS = tuple(f for f in TableState._fields if f != "key")
+
+
+def autogrow_limit_per_shard(total_rows: int, n_shards: int,
+                             cap_local: int) -> int:
+    """Config's cache_autogrow_max (TOTAL rows, an upper bound) → the
+    per-shard ceiling ShardedEngine takes: rounded DOWN to a power of
+    two (a memory bound must never be exceeded), floored at the current
+    capacity (a bound below it just disables growth)."""
+    if total_rows <= 0:
+        return 0
+    agl = max(total_rows // n_shards, cap_local)
+    return 1 << (agl.bit_length() - 1)
 
 
 def make_gather_rows(mesh):
@@ -127,7 +139,10 @@ def make_grow(mesh, cap_new: int):
                                valid, jnp.full(cap_old, -1, jnp.int32))
         placed = valid & (row >= 0)
         wrow = jnp.where(placed, row, cap_new)
-        fresh = init_table_like(cap_new, state)
+        # init_table is shard_map-safe (no device placement; its guards
+        # are host-side trace-time checks) and the single source of
+        # truth for column defaults
+        fresh = init_table(cap_new)
         new = {"key": tkey}
         for f in VALUE_COLS:
             new[f] = getattr(fresh, f).at[wrow].set(getattr(state, f),
@@ -139,16 +154,6 @@ def make_grow(mesh, cap_new: int):
     return jax.jit(shard_map(
         _grow, mesh=mesh, in_specs=P(SHARD_AXIS),
         out_specs=(P(SHARD_AXIS), P())))
-
-
-def init_table_like(capacity: int, state: TableState) -> TableState:
-    """Empty per-shard table (shard_map-safe: init_table does no device
-    placement and its guards are host-side trace-time checks, so there
-    is exactly one source of truth for column defaults)."""
-    del state  # dtypes are init_table's to define
-    from ..core.table import init_table
-
-    return init_table(capacity)
 
 
 def make_sharded_step(mesh):
@@ -178,6 +183,59 @@ def make_sharded_step(mesh):
     return jax.jit(sharded)
 
 
+#: Packed-transfer wire layout for the serving step: every RequestBatch
+#: int64 column rides one [7, B] int64 upload (key bit-viewed), the
+#: int32/bool columns one [3, B] int32 upload, and all five outputs one
+#: [5, B] int64 download.  A device call then costs 2 uploads + 1
+#: download instead of 10 + 5 — per-transfer latency (PCIe doorbells, or
+#: milliseconds over a tunneled link) dominates these tiny arrays, not
+#: bandwidth.
+PACK64 = ("key", "hits", "limit", "duration", "eff_ms", "greg_end", "burst")
+PACK32 = ("behavior", "algorithm", "valid")
+
+
+def pack_wave_host(b: RequestBatch) -> tuple[np.ndarray, np.ndarray]:
+    """RequestBatch of numpy columns → ([7,B] i64, [3,B] i32)."""
+    B = len(b.key)
+    a64 = np.empty((len(PACK64), B), np.int64)
+    a64[0] = np.asarray(b.key).view(np.int64)
+    for i, f in enumerate(PACK64[1:], start=1):
+        a64[i] = getattr(b, f)
+    a32 = np.empty((len(PACK32), B), np.int32)
+    a32[0] = b.behavior
+    a32[1] = b.algorithm
+    a32[2] = b.valid
+    return a64, a32
+
+
+def make_sharded_step_packed(mesh):
+    """The serving twin of make_sharded_step over the packed wire layout
+    (see PACK64/PACK32): (state, a64, a32, now) → (state, [5,B] i64
+    outputs, (over, insert) counters)."""
+    S = SHARD_AXIS
+
+    def _step(state, a64, a32, now):
+        batch = RequestBatch(
+            key=lax.bitcast_convert_type(a64[0], jnp.uint64),
+            hits=a64[1], limit=a64[2], duration=a64[3], eff_ms=a64[4],
+            greg_end=a64[5], burst=a64[6],
+            behavior=a32[0], algorithm=a32[1], valid=a32[2] != 0)
+        state, out = decide_batch_impl(state, batch, now)
+        packed = jnp.stack([
+            out.status.astype(jnp.int64), out.remaining, out.reset_time,
+            out.limit, out.err.astype(jnp.int64)])
+        over = lax.psum(out.over_count, S)
+        ins = lax.psum(out.insert_count, S)
+        return state, packed, (over, ins)
+
+    sharded = shard_map(
+        _step, mesh=mesh,
+        in_specs=(P(S), P(None, S), P(None, S), P()),
+        out_specs=(P(S), P(None, S), P()),
+    )
+    return jax.jit(sharded)
+
+
 class ShardedEngine:
     """Host dispatcher over a sharded table: the multi-chip analog of the
     reference's V1Instance request router (gubernator.go ›
@@ -196,8 +254,9 @@ class ShardedEngine:
         #: neither do we until this bound.
         self.auto_grow_limit = auto_grow_limit
         self.state = shard_table(self.mesh, capacity_per_shard)
-        self._step = make_sharded_step(self.mesh)
+        self._step = make_sharded_step_packed(self.mesh)
         self._batch_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self._mat_sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
         self._repl = NamedSharding(self.mesh, P())
         self.over_count = 0
         self.insert_count = 0
@@ -251,10 +310,19 @@ class ShardedEngine:
         return self._pallas_sweep_fn(self.state, jnp.asarray(now_ms,
                                                              jnp.int64))
 
-    def _put_batch(self, b: RequestBatch) -> RequestBatch:
-        return RequestBatch(*[
-            jax.device_put(np.asarray(x), self._batch_sharding) for x in b
-        ])
+    def _run_wave(self, glob: RequestBatch, now_ms: int):
+        """One device launch over the packed wire layout: 2 uploads, the
+        step, 1 download.  Returns (status, remaining, reset, limit,
+        table_full) host arrays in [n·B] block order."""
+        a64, a32 = pack_wave_host(glob)
+        d64 = jax.device_put(a64, self._mat_sharding)
+        d32 = jax.device_put(a32, self._mat_sharding)
+        self.state, packed, counters = self._step(
+            self.state, d64, d32, np.int64(now_ms))
+        out = np.asarray(packed)
+        self.over_count += int(counters[0])
+        self.insert_count += int(counters[1])
+        return out[0], out[1], out[2], out[3], out[4] != 0
 
     def check_batch(self, reqs: Sequence[RateLimitRequest], now_ms: int
                     ) -> List[RateLimitResponse]:
@@ -295,12 +363,7 @@ class ShardedEngine:
                 np.asarray(glob[f])[positions] = packed[f][:len(wave)]
             slot_of = list(zip(wave, wave_pos))
             errs_all = {i: errs[j] for j, i in enumerate(wave) if errs[j]}
-            dev_batch = self._put_batch(glob)
-            self.state, outs, counters = self._step(
-                self.state, dev_batch, np.int64(now_ms))
-            status, rem, rst, lim, err = [np.asarray(x) for x in outs]
-            self.over_count += int(counters[0])
-            self.insert_count += int(counters[1])
+            status, rem, rst, lim, err = self._run_wave(glob, now_ms)
             swept = False
             for i, slot in slot_of:
                 if i in errs_all:
@@ -378,13 +441,8 @@ class ShardedEngine:
                 glob = empty_batch(self.n * self.B)
                 for f in range(len(glob)):
                     np.asarray(glob[f])[slots] = np.asarray(batch[f])[idx]
-                dev = self._put_batch(glob)
-                self.state, outs, counters = self._step(
-                    self.state, dev, np.int64(now_ms))
-                o_st, o_rem, o_rst, o_lim, o_err = [np.asarray(x)
-                                                    for x in outs]
-                self.over_count += int(counters[0])
-                self.insert_count += int(counters[1])
+                o_st, o_rem, o_rst, o_lim, o_err = self._run_wave(
+                    glob, now_ms)
                 status[idx] = o_st[slots]
                 rem_o[idx] = o_rem[slots]
                 rst_o[idx] = o_rst[slots]
@@ -569,7 +627,7 @@ class ShardedEngine:
                     placed += 1
                     break
         sh = table_sharding(self.mesh)
-        from ..core.table import TableState
+        from ..core.table import TableState, init_table
 
         self.state = TableState(**{
             f: jax.device_put(v, sh) for f, v in host.items()})

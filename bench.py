@@ -307,9 +307,29 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
         for r in range(reps):
             inst.get_rate_limits(reqs5[r % 4], now_ms=NOW0 + 1 + r)
         dps_svc = reps * 1000 / (time.perf_counter() - t0)
-        inst.close()
         out["6_service_path"] = {"decisions_per_s": round(dps_svc),
                                  "batch": 1000}
+        # the C++ wire lane (bytes → columns → device → bytes), the
+        # path a gRPC client actually exercises
+        try:
+            from gubernator_tpu.proto import gubernator_pb2 as pb
+            from gubernator_tpu.wire import req_to_pb
+
+            datas = []
+            for rs in reqs5:
+                m = pb.GetRateLimitsReq()
+                m.requests.extend(req_to_pb(r) for r in rs)
+                datas.append(m.SerializeToString())
+            inst.get_rate_limits_wire(datas[0], now_ms=NOW0 + 100)
+            t0 = time.perf_counter()
+            for r in range(reps):
+                inst.get_rate_limits_wire(datas[r % 4],
+                                          now_ms=NOW0 + 101 + r)
+            out["6_service_path"]["wire_lane_decisions_per_s"] = round(
+                reps * 1000 / (time.perf_counter() - t0))
+        except Exception as e:  # noqa: BLE001
+            out["6_service_path"]["wire_lane_error"] = str(e)[:200]
+        inst.close()
     except Exception as e:  # noqa: BLE001
         out["6_service_path"] = {"error": str(e)[:200]}
 

@@ -711,6 +711,9 @@ class V1Instance:
             status = "unhealthy"
             msg = self.mr_manager.last_error
         self.metrics.cache_size.set(int(self.engine_occupancy()))
+        self.metrics.cache_capacity.set(self.engine.cap_local
+                                        * self.engine.n)
+        self.metrics.dropped_rows.set(self.engine.dropped_rows)
         return HealthCheckResponse(status=status, message=msg,
                                    peer_count=len(self.peers()))
 

@@ -57,6 +57,13 @@ class Metrics:
         self.concurrent_checks = Gauge(
             "gubernator_concurrent_checks_counter",
             "in-flight GetRateLimits batches", registry=r)
+        self.cache_capacity = Gauge(
+            "gubernator_cache_capacity",
+            "total counter-table rows (grows under auto-grow)", registry=r)
+        self.dropped_rows = Gauge(
+            "gubernator_cache_dropped_rows",
+            "live rows lost to grow/restore re-placement (each is a "
+            "counter reset, the LRU-eviction analog)", registry=r)
 
     @contextmanager
     def time_func(self, name: str):

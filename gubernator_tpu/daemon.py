@@ -22,7 +22,7 @@ import grpc
 
 from .config import DaemonConfig
 from .discovery import make_discovery
-from .grpc_api import add_peers_servicer, add_v1_servicer_raw
+from .grpc_api import add_peers_servicer_raw, add_v1_servicer_raw
 from .instance import V1Instance
 from .netutil import resolve_host_ip, split_host_port
 from .proto import gubernator_pb2 as pb
@@ -80,6 +80,14 @@ class _PeersServicer:
             out = peers_pb.GetPeerRateLimitsResp()
             out.rate_limits.extend(resp_to_pb(r) for r in resps)
             return out
+
+    def GetPeerRateLimitsWire(self, request: bytes, context):
+        """Raw-bytes twin of GetPeerRateLimits (C++ wire lane)."""
+        with span("grpc.GetPeerRateLimits", metrics=self.instance.metrics):
+            try:
+                return self.instance.get_peer_rate_limits_wire(request)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
     def UpdatePeerGlobals(self, request: peers_pb.UpdatePeerGlobalsReq,
                           context):
@@ -171,7 +179,8 @@ class Daemon:
                                   limit=1, duration=1000)])
             add_v1_servicer_raw(self.grpc_server,
                                 _V1Servicer(self.instance))
-            add_peers_servicer(self.grpc_server, _PeersServicer(self.instance))
+            add_peers_servicer_raw(self.grpc_server,
+                                   _PeersServicer(self.instance))
             self.grpc_server.start()
 
             if cfg.http_listen_address:

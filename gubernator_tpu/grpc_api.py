@@ -71,6 +71,24 @@ def add_peers_servicer(server: grpc.Server, servicer) -> None:
         (grpc.method_handlers_generic_handler(PEERS_SERVICE, handlers),))
 
 
+def add_peers_servicer_raw(server: grpc.Server, servicer) -> None:
+    """Like add_peers_servicer, but GetPeerRateLimits passes raw bytes
+    (servicer.GetPeerRateLimitsWire(data, ctx) → bytes) for the C++ wire
+    lane.  UpdatePeerGlobals keeps pb2 (cold path)."""
+    handlers = {
+        "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPeerRateLimitsWire,
+            request_deserializer=None,
+            response_serializer=None),
+        "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
+            servicer.UpdatePeerGlobals,
+            request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
+            response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(PEERS_SERVICE, handlers),))
+
+
 class V1Stub:
     """Client stub for the V1 service (generated-code equivalent)."""
 

@@ -262,35 +262,77 @@ class V1Instance:
         self.metrics.concurrent_checks.inc()
         try:
             with self.metrics.time_func("GetRateLimits"):
-                from .core.batch import pack_columns
-                from .hashing import mix64_np
-
-                kh = mix64_np(parsed["khash_raw"])
-                kh = np.where(kh == 0, np.uint64(1), kh)
-                batch, errs = pack_columns(
-                    kh, parsed["hits"], parsed["limit"],
-                    parsed["duration"], parsed["algorithm"],
-                    parsed["behavior"], parsed["burst"], now)
-                status, lim, rem, rst, full = self.dispatcher.check_packed(
-                    batch, kh, now)
-                self.metrics.over_limit_counter.inc(
-                    int((status == 1).sum()))
-                errors = None
-                if errs or full.any():
-                    # errored rows already come back zeroed from the
-                    # device (invalid/overfull rows are masked out)
-                    errors = [None] * n
-                    for i, emsg in errs.items():
-                        errors[i] = emsg
-                    for i in np.nonzero(full)[0]:
-                        if errors[int(i)] is None:
-                            errors[int(i)] = "rate limit table full"
-                out_bytes = _wire_native.build_rate_limit_resps(
-                    status, lim, rem, rst, errors)
+                out_bytes = self._wire_check_columns(parsed, now)
                 self._maybe_sweep(now)
                 return out_bytes
         finally:
             self.metrics.concurrent_checks.dec()
+
+    def get_peer_rate_limits_wire(self, data: bytes,
+                                  now_ms: Optional[int] = None) -> bytes:
+        """Wire-to-wire GetPeerRateLimits — the owner side of request
+        forwarding (peers.proto uses the same RateLimitReq/RateLimitResp
+        submessages on field 1, so the C++ codec applies verbatim).
+        Forwarded batches always apply locally, so peer membership does
+        not gate the fast lane; GLOBAL/MULTI_REGION batches still fall
+        back (they queue broadcast/replication work per request)."""
+        parsed = None
+        if _wire_native is not None and self.store is None:
+            parsed = _wire_native.parse_get_rate_limits(data)
+            if parsed is not None and (
+                    parsed["behavior_or"] & self._FAST_EXCLUDED):
+                parsed = None
+        if parsed is None:
+            from google.protobuf.message import DecodeError
+
+            from .wire import req_from_pb, resp_to_pb
+
+            try:
+                msg = peers_pb.GetPeerRateLimitsReq.FromString(data)
+            except DecodeError as e:
+                raise ValueError(
+                    f"invalid GetPeerRateLimitsReq: {e}") from e
+            reqs = [req_from_pb(m) for m in msg.requests]
+            resps = self.get_peer_rate_limits(reqs, now_ms=now_ms)
+            out = peers_pb.GetPeerRateLimitsResp()
+            out.rate_limits.extend(resp_to_pb(r) for r in resps)
+            return out.SerializeToString()
+        if parsed["n"] > self.config.behaviors.batch_limit:
+            raise ValueError(
+                "'PeerRequest.rate_limits' list too large; max size is "
+                f"{self.config.behaviors.batch_limit}")
+        now = clock_ms() if now_ms is None else now_ms
+        self.metrics.getratelimit_counter.labels(calltype="peer").inc(
+            parsed["n"])
+        return self._wire_check_columns(parsed, now)
+
+    def _wire_check_columns(self, parsed: dict, now: int) -> bytes:
+        """Shared fast-lane body: parsed columns → device step →
+        serialized responses (identical for the client and peer wire)."""
+        from .core.batch import pack_columns
+        from .hashing import mix64_np
+
+        n = parsed["n"]
+        kh = mix64_np(parsed["khash_raw"])
+        kh = np.where(kh == 0, np.uint64(1), kh)
+        batch, errs = pack_columns(
+            kh, parsed["hits"], parsed["limit"], parsed["duration"],
+            parsed["algorithm"], parsed["behavior"], parsed["burst"], now)
+        status, lim, rem, rst, full = self.dispatcher.check_packed(
+            batch, kh, now)
+        self.metrics.over_limit_counter.inc(int((status == 1).sum()))
+        errors = None
+        if errs or full.any():
+            # errored rows already come back zeroed from the device
+            # (invalid/overfull rows are masked out)
+            errors = [None] * n
+            for i, emsg in errs.items():
+                errors[i] = emsg
+            for i in np.nonzero(full)[0]:
+                if errors[int(i)] is None:
+                    errors[int(i)] = "rate limit table full"
+        return _wire_native.build_rate_limit_resps(
+            status, lim, rem, rst, errors)
 
     def _get_rate_limits(self, reqs, now) -> List[RateLimitResponse]:
         n = len(reqs)

@@ -445,5 +445,55 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
     return out
 
 
+def _watchdog_main():
+    """Wrapper (the default entry): run the real bench in a subprocess
+    with a deadline, and if the TPU attempt hangs or dies — the axon
+    tunnel has twice been observed to wedge indefinitely after a
+    timed-out compile — re-run on CPU so the driver always gets its one
+    JSON line instead of a hung process.
+    """
+    import subprocess
+
+    deadline = int(os.environ.get("GUBER_BENCH_TIMEOUT", "2700"))
+    env = dict(os.environ, GUBER_BENCH_INNER="1")
+
+    def attempt(extra_env, timeout):
+        e = dict(env, **extra_env)
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=e, timeout=timeout,
+                               stdout=subprocess.PIPE)
+            line = (r.stdout or b"").decode().strip().splitlines()
+            if r.returncode == 0 and line and line[-1].startswith("{"):
+                return line[-1]
+        except subprocess.TimeoutExpired:
+            log(f"bench attempt timed out after {timeout}s")
+        except Exception as e2:  # noqa: BLE001
+            log(f"bench attempt failed: {e2!r}")
+        return None
+
+    out = attempt({}, deadline)
+    if out is None and os.environ.get("GUBER_JAX_PLATFORM", "") != "cpu":
+        log("falling back to CPU (device backend unreachable or hung)")
+        out = attempt({"GUBER_JAX_PLATFORM": "cpu",
+                       "GUBER_BENCH_FAST": "1",
+                       "GUBER_BENCH_SCAN": "4"}, 1800)
+        if out is not None:
+            d = json.loads(out)
+            d["extra"]["note"] = ("CPU FALLBACK: the TPU backend was "
+                                  "unreachable/hung; see BASELINE.md for "
+                                  "the recorded TPU numbers")
+            out = json.dumps(d)
+    if out is None:
+        out = json.dumps({
+            "metric": "rate-limit decisions/sec/chip @1M-key Zipf(1.1)",
+            "value": 0, "unit": "decisions/s", "vs_baseline": 0.0,
+            "extra": {"error": "all bench attempts failed or timed out"}})
+    print(out)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("GUBER_BENCH_INNER"):
+        main()
+    else:
+        _watchdog_main()

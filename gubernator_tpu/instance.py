@@ -100,7 +100,7 @@ class V1Instance:
         # lazily built on first promotion; pod-local only.
         self._hotset = None
         self._hot_mu = threading.Lock()
-        self._hot_counts: Dict[str, int] = {}
+        self._hot_counts: Dict[int, int] = {}  # key_hash → weight
         self._hot_sync_loop = None
         self._promote_pending: List[tuple] = []
         self._closed = False
@@ -223,50 +223,68 @@ class V1Instance:
         Takes the C++ columnar fast lane (ops/_native.cpp: wire bytes →
         packed arrays → one device step → wire bytes, zero per-request
         Python objects) when the batch qualifies: extension built, no
-        peers, no Store hooks, no GLOBAL/MULTI_REGION behaviors, no
-        metadata, non-empty names/keys.  Anything else falls back to the
-        pb2 object path with identical semantics.  Raises ValueError on
-        oversize batches (mirroring ``get_rate_limits``).
+        peers, no Store hooks, no MULTI_REGION behaviors, no metadata,
+        non-empty names/keys.  Solo GLOBAL batches ride a columnar
+        hot-set flow (pinned keys → replica step, the rest → sharded
+        step + vectorized promotion counting); anything the lanes can't
+        model falls back to the pb2 object path with identical
+        semantics.  Raises ValueError on oversize batches (mirroring
+        ``get_rate_limits``).
         """
         parsed = None
+        is_global = False
         if (_wire_native is not None and self.store is None
                 and not self.peers()):
             parsed = _wire_native.parse_get_rate_limits(data)
-            if parsed is not None and (
-                    parsed["behavior_or"] & self._FAST_EXCLUDED):
-                parsed = None
-        if parsed is None:
-            from google.protobuf.message import DecodeError
+            if parsed is not None:
+                if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
+                    parsed = None
+                else:
+                    # solo GLOBAL rides the columnar hot-set flow; the
+                    # object path's queue_update is a no-op with no
+                    # peers (nothing to broadcast to)
+                    is_global = bool(parsed["behavior_or"]
+                                     & int(Behavior.GLOBAL))
+        if parsed is not None:
+            n = parsed["n"]
+            if n > MAX_BATCH_SIZE:
+                raise ValueError(
+                    f"Requests.RateLimits list too large; max size is "
+                    f"{MAX_BATCH_SIZE}")
+            now = clock_ms() if now_ms is None else now_ms
+            # all gating happens before metrics or state are touched:
+            # a None runner falls through to the object path untouched
+            runner = (self._wire_global_runner(parsed, now) if is_global
+                      else (lambda: self._wire_check_columns(parsed,
+                                                             now)))
+            if runner is not None:
+                self.metrics.getratelimit_counter.labels(
+                    calltype="api").inc(n)
+                self.metrics.concurrent_checks.inc()
+                try:
+                    with self.metrics.time_func("GetRateLimits"):
+                        out_bytes = runner()
+                        self._maybe_sweep(now)
+                        return out_bytes
+                finally:
+                    self.metrics.concurrent_checks.dec()
+        # pb2 object path: everything the columnar lanes can't model
+        from google.protobuf.message import DecodeError
 
-            from .wire import req_from_pb, resp_to_pb
+        from .wire import req_from_pb, resp_to_pb
 
-            try:
-                msg = pb.GetRateLimitsReq.FromString(data)
-            except DecodeError as e:
-                # surfaced as INVALID_ARGUMENT by the servicer, matching
-                # what a grpc-layer deserializer failure produced before
-                # the raw-bytes handler existed
-                raise ValueError(f"invalid GetRateLimitsReq: {e}") from e
-            reqs = [req_from_pb(m) for m in msg.requests]
-            resps = self.get_rate_limits(reqs, now_ms=now_ms)
-            out = pb.GetRateLimitsResp()
-            out.responses.extend(resp_to_pb(r) for r in resps)
-            return out.SerializeToString()
-        n = parsed["n"]
-        if n > MAX_BATCH_SIZE:
-            raise ValueError(
-                f"Requests.RateLimits list too large; max size is "
-                f"{MAX_BATCH_SIZE}")
-        now = clock_ms() if now_ms is None else now_ms
-        self.metrics.getratelimit_counter.labels(calltype="api").inc(n)
-        self.metrics.concurrent_checks.inc()
         try:
-            with self.metrics.time_func("GetRateLimits"):
-                out_bytes = self._wire_check_columns(parsed, now)
-                self._maybe_sweep(now)
-                return out_bytes
-        finally:
-            self.metrics.concurrent_checks.dec()
+            msg = pb.GetRateLimitsReq.FromString(data)
+        except DecodeError as e:
+            # surfaced as INVALID_ARGUMENT by the servicer, matching
+            # what a grpc-layer deserializer failure produced before
+            # the raw-bytes handler existed
+            raise ValueError(f"invalid GetRateLimitsReq: {e}") from e
+        reqs = [req_from_pb(m) for m in msg.requests]
+        resps = self.get_rate_limits(reqs, now_ms=now_ms)
+        out = pb.GetRateLimitsResp()
+        out.responses.extend(resp_to_pb(r) for r in resps)
+        return out.SerializeToString()
 
     def get_peer_rate_limits_wire(self, data: bytes,
                                   now_ms: Optional[int] = None) -> bytes:
@@ -305,6 +323,126 @@ class V1Instance:
         self.metrics.getratelimit_counter.labels(calltype="peer").inc(
             parsed["n"])
         return self._wire_check_columns(parsed, now)
+
+    def _wire_global_runner(self, parsed: dict, now: int):
+        """Columnar solo-GLOBAL flow (the wire-lane twin of
+        ``_hot_route``): pinned keys take the replicated hot-set step,
+        everything else the sharded step, with vectorized promotion
+        counting.  Returns a zero-argument executor, or None when a
+        per-request case needs the object path (a pinned key whose
+        config changed or that received excluded flags — those demote).
+
+        All gating runs here, before any state mutation, so a None
+        return leaves the instance untouched for the fallback.
+        """
+        if self.config.hot_set_capacity <= 0:
+            # tier disabled: solo GLOBAL is just the local path (the
+            # object path's queue_update broadcasts to no one)
+            return lambda: self._wire_check_columns(parsed, now)
+        from .core.batch import pack_columns
+        from .hashing import mix64_np
+
+        n = parsed["n"]
+        kh = mix64_np(parsed["khash_raw"])
+        kh = np.where(kh == 0, np.uint64(1), kh)
+        batch, errs = pack_columns(
+            kh, parsed["hits"], parsed["limit"], parsed["duration"],
+            parsed["algorithm"], parsed["behavior"], parsed["burst"], now)
+        beh = np.asarray(batch.behavior)
+        glob_mask = (beh & int(Behavior.GLOBAL)) != 0
+        excluded = (beh & int(self._HOT_EXCLUDED)) != 0
+        hs = self._hotset
+        hot_mask = np.zeros(n, bool)
+        if hs is not None and hs.slots:
+            with hs._mu:
+                pinned_keys = np.fromiter(hs.slots.keys(), np.uint64,
+                                          len(hs.slots))
+            pinned_mask = glob_mask & np.isin(kh, pinned_keys)
+            if pinned_mask.any():
+                if (pinned_mask & excluded).any():
+                    return None  # flagged request on a pinned key
+                # config match, vectorized over the few unique hot keys
+                alg = np.asarray(batch.algorithm)
+                lim = np.asarray(batch.limit)
+                dur = np.maximum(np.asarray(batch.duration), 1)
+                bur = np.asarray(batch.burst)
+                for k in np.unique(kh[pinned_mask]):
+                    cfg = hs.pinned_cfg.get(int(k))
+                    m = pinned_mask & (kh == k)
+                    if cfg is None or not (
+                            (alg[m] == cfg[0]).all()
+                            and (lim[m] == cfg[1]).all()
+                            and (dur[m] == cfg[2]).all()
+                            and (bur[m] == cfg[3]).all()):
+                        return None  # config changed → demote path
+                hot_mask = pinned_mask
+        # promotion counting for unpinned qualifying GLOBAL keys
+        promo_mask = glob_mask & ~hot_mask & ~excluded & \
+            np.asarray(batch.valid)
+
+        def run() -> bytes:
+            status = np.zeros(n, np.int64)
+            rem = np.zeros(n, np.int64)
+            rst = np.zeros(n, np.int64)
+            lim_o = np.zeros(n, np.int64)
+            errors: Optional[list] = None
+            if promo_mask.any():
+                pidx = np.nonzero(promo_mask)[0]
+                w = np.maximum(np.asarray(batch.hits)[pidx], 1)
+                uniq, first, inv = np.unique(
+                    kh[pidx], return_index=True, return_inverse=True)
+                weights = np.bincount(inv, weights=w).astype(np.int64)
+                hits_col = np.asarray(batch.hits)
+                for k, f, wt in zip(uniq, first, weights):
+                    i = int(pidx[f])  # first occurrence in the batch
+                    self._count_toward_promotion(
+                        int(k), int(wt), RateLimitRequest(
+                            name="", unique_key="",
+                            hits=int(hits_col[i]),
+                            limit=int(np.asarray(batch.limit)[i]),
+                            duration=int(np.asarray(batch.duration)[i]),
+                            algorithm=int(np.asarray(
+                                batch.algorithm)[i]),
+                            behavior=int(beh[i]),
+                            burst=int(np.asarray(batch.burst)[i])))
+            shard_mask = ~hot_mask
+            if shard_mask.any():
+                idx = np.nonzero(shard_mask)[0]
+                sub = type(batch)(*[np.asarray(c)[idx] for c in batch])
+                s_st, s_lim, s_rem, s_rst, s_full = \
+                    self.dispatcher.check_packed(sub, kh[idx], now)
+                status[idx] = s_st
+                lim_o[idx] = s_lim
+                rem[idx] = s_rem
+                rst[idx] = s_rst
+                if s_full.any():
+                    errors = [None] * n
+                    for j in np.nonzero(s_full)[0]:
+                        errors[int(idx[j])] = "rate limit table full"
+            if hot_mask.any():
+                idx = np.nonzero(hot_mask)[0]
+                sub = type(batch)(*[np.asarray(c)[idx] for c in batch])
+                h_st, h_rem, h_rst, h_lim, h_lost = hs.check_columns(
+                    sub, kh[idx], now)
+                status[idx] = h_st
+                rem[idx] = h_rem
+                rst[idx] = h_rst
+                lim_o[idx] = h_lim
+                if h_lost.any():
+                    errors = errors or [None] * n
+                    for j in np.nonzero(h_lost)[0]:
+                        errors[int(idx[j])] = "hot-set row lost"
+            if errs:
+                errors = errors or [None] * n
+                for i, emsg in errs.items():
+                    errors[i] = emsg
+            self.metrics.over_limit_counter.inc(int((status == 1).sum()))
+            if self._promote_pending:
+                self._drain_promotions(now)
+            return _wire_native.build_rate_limit_resps(
+                status, lim_o, rem, rst, errors)
+
+        return run
 
     def _wire_check_columns(self, parsed: dict, now: int) -> bytes:
         """Shared fast-lane body: parsed columns → device step →
@@ -480,21 +618,27 @@ class V1Instance:
             return True
         if not qualifies:
             return False
-        # promotion bookkeeping (guarded: concurrent handlers must not
-        # double-promote or KeyError on the shared counter dict)
+        self._count_toward_promotion(kh, max(int(req.hits), 1), req)
+        return False
+
+    def _count_toward_promotion(self, kh: int, weight: int,
+                                req: RateLimitRequest) -> None:
+        """Promotion bookkeeping, keyed by key hash (guarded: concurrent
+        handlers must not double-promote or KeyError on the shared
+        counter dict).  ``req`` carries the (limit, duration, algorithm,
+        burst) the pin will adopt."""
         with self._hot_mu:
-            c = self._hot_counts.get(req.key, 0) + max(int(req.hits), 1)
-            self._hot_counts[req.key] = c
+            c = self._hot_counts.get(kh, 0) + weight
+            self._hot_counts[kh] = c
             if c >= self.config.hot_promote_threshold:
                 # promote AFTER this batch's device step so the seed
                 # row includes this request's own hits
                 self._promote_pending.append((req, kh))
-                self._hot_counts.pop(req.key, None)
+                self._hot_counts.pop(kh, None)
             elif len(self._hot_counts) > 100_000:
                 # decay inline too: _maybe_sweep may be disabled, and
                 # the counter dict must stay bounded regardless
                 self._decay_counts_locked()
-        return False
 
     def _drain_promotions(self, now: int) -> None:
         """Pin newly-hot keys, seeding from their sharded-table rows so

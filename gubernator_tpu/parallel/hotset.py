@@ -365,6 +365,53 @@ class HotSetEngine:
             pending = rest
         return responses  # type: ignore[return-value]
 
+    def check_columns(self, batch: RequestBatch, khash: np.ndarray,
+                      now_ms: int) -> tuple:
+        """Columnar twin of ``check_batch`` (the wire lane's GLOBAL
+        path): numpy RequestBatch columns in, response columns out —
+        (status, remaining, reset_time, limit, row_lost) arrays.  Any
+        replica answers; placement round-robins across chips."""
+        n_req = len(khash)
+        status = np.zeros(n_req, np.int64)
+        rem = np.zeros(n_req, np.int64)
+        rst = np.zeros(n_req, np.int64)
+        lim = np.zeros(n_req, np.int64)
+        lost = np.zeros(n_req, bool)
+        W = self.n * self.B
+        done = 0
+        while done < n_req:
+            m = min(W, n_req - done)
+            p = np.arange(m)
+            chip = (self._rr + p) % self.n
+            self._rr += m
+            # fill order per chip → block positions [chip·B + row]
+            order = np.argsort(chip, kind="stable")
+            cs = chip[order]
+            starts = np.searchsorted(cs, np.arange(self.n))
+            rowin = np.empty(m, np.int64)
+            rowin[order] = np.arange(m) - starts[cs]
+            positions = chip * self.B + rowin
+            glob = empty_batch(W)
+            for f in range(len(glob)):
+                np.asarray(glob[f])[positions] = \
+                    np.asarray(batch[f])[done:done + m]
+            sh = _rep(self.mesh)
+            dev = RequestBatch(*[
+                jax.device_put(np.asarray(x).reshape(self.n, self.B), sh)
+                for x in glob])
+            with self._state_mu:
+                self.state, outs = self._step(
+                    self.state, dev, jnp.asarray(now_ms, jnp.int64))
+            o_st, o_rem, o_rst, o_lim, o_err = [
+                np.asarray(x).reshape(-1) for x in outs]
+            status[done:done + m] = o_st[positions]
+            rem[done:done + m] = o_rem[positions]
+            rst[done:done + m] = o_rst[positions]
+            lim[done:done + m] = o_lim[positions]
+            lost[done:done + m] = o_err[positions]
+            done += m
+        return status, rem, rst, lim, lost
+
     # ---- the tick -------------------------------------------------------
 
     def sync(self) -> None:

@@ -1,0 +1,160 @@
+"""Columnar solo-GLOBAL wire lane: the hot-set psum tier driven from
+wire bytes (instance._wire_global_runner), vs the object path."""
+import numpy as np
+import pytest
+
+from gubernator_tpu.config import BehaviorConfig, Config
+from gubernator_tpu.hashing import hash_key
+from gubernator_tpu.instance import V1Instance, _wire_native
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.types import Behavior, RateLimitRequest, Status
+from gubernator_tpu.wire import req_to_pb
+
+if _wire_native is None:  # pragma: no cover
+    pytest.skip("native extension not built", allow_module_level=True)
+
+NOW = 1_773_000_000_000
+
+
+def mk_instance(threshold=4):
+    # sync_wait effectively infinite: these tests assert exact
+    # replica-local values, so the periodic psum fold must only run
+    # when called explicitly (a tick mid-test legally changes
+    # remaining — GLOBAL is eventually consistent)
+    return V1Instance(
+        Config(cache_size=1 << 10, sweep_interval_ms=0,
+               hot_set_capacity=64, hot_promote_threshold=threshold,
+               behaviors=BehaviorConfig(global_sync_wait_ms=10**9)),
+        mesh=make_mesh(n=4))
+
+
+def greq(key="wg", hits=1, limit=1000, duration=600_000, **kw):
+    kw.setdefault("behavior", Behavior.GLOBAL)
+    return RateLimitRequest(name="wgl", unique_key=key, hits=hits,
+                            limit=limit, duration=duration, **kw)
+
+
+def wire(reqs):
+    m = pb.GetRateLimitsReq()
+    m.requests.extend(req_to_pb(r) for r in reqs)
+    return m.SerializeToString()
+
+
+def send(inst, reqs, now):
+    return list(pb.GetRateLimitsResp.FromString(
+        inst.get_rate_limits_wire(wire(reqs), now_ms=now)).responses)
+
+
+def test_wire_global_promotes_then_serves_hot():
+    inst = mk_instance(threshold=4)
+    try:
+        kh = hash_key("wgl", "wg")
+        rs = send(inst, [greq() for _ in range(6)], NOW)
+        assert all(r.error == "" and int(r.status) == 0 for r in rs)
+        # threshold crossed inside the batch → pinned after the drain
+        assert inst._hotset is not None and inst._hotset.is_pinned(kh)
+        # hot serving: replicas answer; one sync folds consumption
+        rs = send(inst, [greq() for _ in range(40)], NOW + 1)
+        assert all(r.error == "" and int(r.status) == 0 for r in rs)
+        inst._hotset.sync()
+        rs = send(inst, [greq(hits=0)] * 4, NOW + 2)
+        assert len({r.remaining for r in rs}) == 1
+        # 6 pre-promotion hits survive in the seed + 40 hot hits
+        assert rs[0].remaining == 1000 - 46
+    finally:
+        inst.close()
+
+
+def test_wire_vs_object_path_parity():
+    """The same solo-GLOBAL stream through the wire lane and the object
+    path lands on identical decisions (same engines, same routing)."""
+    wi, oi = mk_instance(), mk_instance()
+    try:
+        streams = [[greq(key=f"k{i % 3}") for i in range(12)]
+                   for _ in range(4)]
+        for t, reqs in enumerate(streams):
+            got_w = send(wi, reqs, NOW + t)
+            got_o = oi.get_rate_limits(reqs, now_ms=NOW + t)
+            for i, (w, o) in enumerate(zip(got_w, got_o)):
+                assert (int(w.status), w.remaining, w.reset_time,
+                        w.limit, w.error) == \
+                    (int(o.status), o.remaining, o.reset_time, o.limit,
+                     o.error), (t, i)
+        assert wi._hotset is not None and len(wi._hotset.slots) == 3
+        assert len(oi._hotset.slots) == 3
+    finally:
+        wi.close()
+        oi.close()
+
+
+def test_wire_global_config_change_demotes():
+    inst = mk_instance(threshold=1)
+    try:
+        kh = hash_key("wgl", "cfg")
+        send(inst, [greq(key="cfg", limit=100)], NOW)
+        send(inst, [greq(key="cfg", limit=100) for _ in range(10)],
+             NOW + 1)
+        assert inst._hotset.is_pinned(kh)
+        # changed limit → object-path fallback demotes and re-limits
+        r = send(inst, [greq(key="cfg", limit=50)], NOW + 2)[0]
+        assert not inst._hotset.is_pinned(kh)
+        assert r.limit == 50
+        # 11 consumed at limit 100 → 89; 100→50 adjust → 39; −1 → 38
+        assert r.remaining == 38
+    finally:
+        inst.close()
+
+
+def test_wire_global_flagged_pinned_key_falls_back():
+    inst = mk_instance(threshold=1)
+    try:
+        kh = hash_key("wgl", "flg")
+        send(inst, [greq(key="flg")], NOW)
+        send(inst, [greq(key="flg")], NOW + 1)
+        assert inst._hotset.is_pinned(kh)
+        r = send(inst, [greq(
+            key="flg",
+            behavior=Behavior.GLOBAL | Behavior.RESET_REMAINING)],
+            NOW + 2)[0]
+        assert not inst._hotset.is_pinned(kh)  # demoted by object path
+        assert r.remaining == 999  # RESET_REMAINING → full minus 1
+    finally:
+        inst.close()
+
+
+def test_wire_mixed_global_and_local_batch():
+    inst = mk_instance(threshold=2)
+    try:
+        reqs = [greq(key="mix") if i % 2 == 0 else
+                RateLimitRequest(name="wgl", unique_key="loc", hits=1,
+                                 limit=5, duration=60_000)
+                for i in range(8)]
+        rs = send(inst, reqs, NOW)
+        assert all(r.error == "" for r in rs)
+        # local key consumed 4 of 5
+        assert rs[7].remaining == 1
+    finally:
+        inst.close()
+
+
+def test_wire_global_leaky_rides_hot_tier():
+    from gubernator_tpu.types import Algorithm
+
+    inst = mk_instance(threshold=2)
+    try:
+        kh = hash_key("wgl", "lk")
+        lr = [greq(key="lk", algorithm=Algorithm.LEAKY_BUCKET)
+              for _ in range(10)]
+        rs = send(inst, lr, NOW)
+        assert all(int(r.status) == 0 for r in rs)
+        assert inst._hotset.is_pinned(kh)
+        rs = send(inst, lr, NOW + 1)
+        assert all(int(r.status) == 0 for r in rs)
+        inst._hotset.sync()
+        rs = send(inst, [greq(key="lk", hits=0,
+                              algorithm=Algorithm.LEAKY_BUCKET)],
+                  NOW + 2)
+        assert rs[0].remaining == 1000 - 20
+    finally:
+        inst.close()

@@ -87,23 +87,31 @@ def _cfg_of(req: RateLimitRequest) -> tuple:
 
 
 def make_hot_step(mesh):
-    """Per-chip replica apply: state has leading [n] device axis; each
-    chip runs the full decision program on its own replica and its own
-    sub-batch.  No collectives on the request path."""
+    """Per-chip replica apply over the packed wire layout
+    (sharded.py › PACK64/PACK32: 2 uploads + 1 download per wave):
+    state has leading [n] device axis; each chip runs the full decision
+    program on its own replica and its own sub-batch.  No collectives
+    on the request path."""
 
-    def _step(state, batch, now):
+    def _step(state, a64, a32, now):
         st = jax.tree.map(lambda x: x[0], state)
-        bt = jax.tree.map(lambda x: x[0], batch)
+        bt = RequestBatch(
+            key=lax.bitcast_convert_type(a64[0], jnp.uint64),
+            hits=a64[1], limit=a64[2], duration=a64[3], eff_ms=a64[4],
+            greg_end=a64[5], burst=a64[6],
+            behavior=a32[0], algorithm=a32[1], valid=a32[2] != 0)
         st, out = decide_batch_impl(st, bt, now)
         st = jax.tree.map(lambda x: x[None], st)
-        return st, jax.tree.map(lambda x: x[None],
-                                (out.status, out.remaining, out.reset_time,
-                                 out.limit, out.err))
+        packed = jnp.stack([
+            out.status.astype(jnp.int64), out.remaining, out.reset_time,
+            out.limit, out.err.astype(jnp.int64)])
+        return st, packed
 
     return jax.jit(shard_map(
         _step, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS))))
+        in_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS),
+                  P(None, SHARD_AXIS), P()),
+        out_specs=(P(SHARD_AXIS), P(None, SHARD_AXIS))))
 
 
 def make_hot_sync(mesh):
@@ -317,6 +325,22 @@ class HotSetEngine:
 
     # ---- request path ---------------------------------------------------
 
+    def _run_hot_wave(self, glob: RequestBatch, now_ms: int):
+        """One replica-step launch over the packed layout: 2 uploads +
+        1 download.  ``glob`` holds [n·B] numpy columns in block order;
+        returns (status, remaining, reset_time, limit, lost) arrays."""
+        from .sharded import pack_wave_host
+
+        a64, a32 = pack_wave_host(glob)
+        sh = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+        d64 = jax.device_put(a64, sh)
+        d32 = jax.device_put(a32, sh)
+        with self._state_mu:
+            self.state, packed = self._step(
+                self.state, d64, d32, jnp.asarray(now_ms, jnp.int64))
+        out = np.asarray(packed)
+        return out[0], out[1], out[2], out[3], out[4] != 0
+
     def check_batch(self, reqs: Sequence[RateLimitRequest],
                     key_hashes: Sequence[int], now_ms: int
                     ) -> List[RateLimitResponse]:
@@ -348,15 +372,7 @@ class HotSetEngine:
             for f in range(len(glob)):
                 np.asarray(glob[f])[positions] = packed[f][:len(wave)]
             slot_of = list(zip(wave, positions.tolist()))
-            sh = _rep(self.mesh)
-            dev = RequestBatch(*[
-                jax.device_put(np.asarray(x).reshape(self.n, self.B), sh)
-                for x in glob])
-            with self._state_mu:
-                self.state, outs = self._step(self.state, dev,
-                                              jnp.asarray(now_ms, jnp.int64))
-            status, rem, rst, lim, err = [np.asarray(x).reshape(-1)
-                                          for x in outs]
+            status, rem, rst, lim, err = self._run_hot_wave(glob, now_ms)
             for i, pos in slot_of:
                 responses[i] = RateLimitResponse(
                     status=Status(int(status[pos])), limit=int(lim[pos]),
@@ -395,15 +411,8 @@ class HotSetEngine:
             for f in range(len(glob)):
                 np.asarray(glob[f])[positions] = \
                     np.asarray(batch[f])[done:done + m]
-            sh = _rep(self.mesh)
-            dev = RequestBatch(*[
-                jax.device_put(np.asarray(x).reshape(self.n, self.B), sh)
-                for x in glob])
-            with self._state_mu:
-                self.state, outs = self._step(
-                    self.state, dev, jnp.asarray(now_ms, jnp.int64))
-            o_st, o_rem, o_rst, o_lim, o_err = [
-                np.asarray(x).reshape(-1) for x in outs]
+            o_st, o_rem, o_rst, o_lim, o_err = self._run_hot_wave(
+                glob, now_ms)
             status[done:done + m] = o_st[positions]
             rem[done:done + m] = o_rem[positions]
             rst[done:done + m] = o_rst[positions]

@@ -843,7 +843,11 @@ class V1Instance:
         m = len(updates)
         if m == 0:
             return
-        khash = np.zeros(m, np.uint64)
+        from .hashing import hash_keys
+
+        # identity = hash(name + "_" + unique_key) and g.key IS that
+        # joined string — one native batch hash instead of m scalar ones
+        khash = hash_keys([g.key for g in updates])
         cols = {
             "meta": np.zeros(m, np.int32),
             "limit": np.zeros(m, np.int64),
@@ -855,8 +859,6 @@ class V1Instance:
             "expire_at": np.zeros(m, np.int64),
         }
         for j, g in enumerate(updates):
-            name, _, uniq = g.key.partition("_")
-            khash[j] = np.uint64(hash_key(name, uniq))
             alg = int(g.algorithm)
             if g.behavior & Behavior.DURATION_IS_GREGORIAN:
                 try:

@@ -101,6 +101,12 @@ class Config:
     #: never fails an insert, so enabling this matches that contract up
     #: to the bound).  Rounded to a power of two per shard.
     cache_autogrow_max: int = 0
+    #: Stateful re-sharding (beyond-reference, opt-in): on membership
+    #: change, rows whose ring owner moved are handed to the new owner
+    #: over the peer wire instead of resetting (the reference loses
+    #: re-homed state — SURVEY.md §5.3).  Requires the default picker
+    #: hash (mixed fnv1a64).
+    handover_on_reshard: bool = False
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
     #: This node's datacenter name (multi-region routing).
     data_center: str = ""
@@ -158,6 +164,7 @@ class DaemonConfig:
     advertise_address: str = ""
     cache_size: int = 1 << 16
     cache_autogrow_max: int = 0
+    handover_on_reshard: bool = False
     data_center: str = ""
     instance_id: str = ""
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -191,6 +198,7 @@ class DaemonConfig:
         return Config(
             cache_size=self.cache_size,
             cache_autogrow_max=self.cache_autogrow_max,
+            handover_on_reshard=self.handover_on_reshard,
             behaviors=self.behaviors,
             data_center=self.data_center,
             advertise_address=self.advertise_address or self.grpc_listen_address,
@@ -262,6 +270,8 @@ def setup_daemon_config(conf_file: str = "",
     d.cache_size = src.get("GUBER_CACHE_SIZE", d.cache_size, int)
     d.cache_autogrow_max = src.get("GUBER_CACHE_AUTOGROW_MAX",
                                    d.cache_autogrow_max, int)
+    d.handover_on_reshard = src.get("GUBER_HANDOVER_ON_RESHARD",
+                                    d.handover_on_reshard, bool)
     d.data_center = src.get("GUBER_DATA_CENTER", d.data_center)
     d.instance_id = src.get("GUBER_INSTANCE_ID", d.instance_id)
     d.log_level = src.get("GUBER_LOG_LEVEL", d.log_level)

@@ -103,6 +103,12 @@ class V1Instance:
         self._hot_counts: Dict[int, int] = {}  # key_hash → weight
         self._hot_sync_loop = None
         self._promote_pending: List[tuple] = []
+        # stateful-handover serialization: one pass at a time, and a
+        # generation counter so a newer membership change supersedes an
+        # in-flight pass (it re-snapshots whatever is left)
+        self._handover_mu = threading.Lock()
+        self._handover_gen = 0
+        self._handover_gen_mu = threading.Lock()
         self._closed = False
         self._last_sweep = clock_ms()
         self.store = config.store
@@ -138,6 +144,7 @@ class V1Instance:
         peers.  Keys silently re-home on ring change; moved keys reset
         (documented reference behavior, SURVEY.md §5.3)."""
         with self._peer_mu:
+            old_picker = self._picker  # immutable; handover routes by it
             old = {p.info.grpc_address: p for p in self._picker.peers()}
             picker = self._picker.new()
             for info in infos:
@@ -154,8 +161,139 @@ class V1Instance:
         # The hot-set psum tier is pod-local: once any non-self peer
         # exists (hot routing turns off), hot keys must go back to
         # daemon-level ownership with their consumption intact.
-        if any(info.grpc_address != self._self_addr for info in infos):
+        have_others = any(info.grpc_address != self._self_addr
+                          for info in infos)
+        if have_others:
             self._demote_all()
+        # Stateful re-sharding (beyond-reference, opt-in): the
+        # reference resets re-homed keys (SURVEY.md §5.3); with the
+        # flag on, rows whose ring owner moved are handed to the new
+        # owner over the peer wire instead.
+        if self.config.handover_on_reshard and have_others:
+            with self._handover_gen_mu:
+                self._handover_gen += 1
+                gen = self._handover_gen
+            threading.Thread(target=self._handover_moved_rows,
+                             args=(old_picker, gen),
+                             daemon=True).start()
+
+    @staticmethod
+    def _uses_default_hash(picker) -> bool:
+        """Hash-level routing is only valid on the default pipeline
+        (table key hashes ARE mixed fnv1a64 of the identity string)."""
+        from .hashing import mixed_fnv1a64
+
+        pickers = (list(picker.regions.values())
+                   if isinstance(picker, RegionPeerPicker) else [picker])
+        return all(getattr(pk, "_hash", None) is mixed_fnv1a64
+                   for pk in pickers)
+
+    def _handover_moved_rows(self, old_picker, gen: int) -> None:
+        """Send every live row that this daemon OWNED under the old
+        ring and no longer owns to its new owner (UpdatePeerGlobals
+        with the key_hash + eff_ms extension fields), then drop it
+        locally.  Rows held only as GLOBAL/MULTI_REGION replicas (owned
+        by another peer under the old ring too) stay put — handing a
+        replica over would overwrite the owner's authoritative state.
+
+        Best effort: delivery failure leaves the row in place (the new
+        owner serves a fresh bucket — the reference's reset-on-rehome
+        behavior).  ``gen`` guards against a second membership change
+        mid-flight: a newer set_peers bumps the generation, this pass
+        aborts before its next chunk, and the newer pass re-snapshots
+        whatever is left.  Interim hits on the new owner between the
+        picker swap and the upsert are overwritten — the same bounded
+        window GLOBAL broadcasts already have."""
+        with self._peer_mu:
+            picker = self._picker
+        if not self._uses_default_hash(picker) or (
+                old_picker.peers()
+                and not self._uses_default_hash(old_picker)):
+            log.warning("handover_on_reshard requires the default "
+                        "picker hash; skipping handover")
+            return
+        with self._handover_mu:  # one in-flight pass at a time
+            with self._handover_gen_mu:
+                if self._handover_gen != gen:
+                    return  # superseded before it started
+            with self._engine_mu:
+                snap = self.engine.snapshot()
+            keys = snap.get("key")
+            if keys is None or not len(keys):
+                return
+            had_old = bool(old_picker.peers())
+            moved: Dict[str, list] = {}
+            peers_by_addr: Dict[str, PeerClient] = {}
+            for i, k in enumerate(keys):
+                try:
+                    # only rows we OWNED may move (solo ⇒ we owned all)
+                    if had_old and not self.is_self(
+                            old_picker.get_by_hash(int(k))):
+                        continue
+                    p = picker.get_by_hash(int(k))
+                except RuntimeError:
+                    return  # picker emptied concurrently
+                addr = p.info.grpc_address
+                if addr != self._self_addr:
+                    moved.setdefault(addr, []).append(i)
+                    peers_by_addr[addr] = p
+            if not moved:
+                return
+            limit = self.config.behaviors.global_batch_limit
+            sent = 0
+            for addr, idxs in moved.items():
+                peer = peers_by_addr[addr]
+                for a in range(0, len(idxs), limit):
+                    with self._handover_gen_mu:
+                        if self._handover_gen != gen:
+                            log.info("handover superseded after %d rows",
+                                     sent)
+                            return
+                    chunk = idxs[a:a + limit]
+                    batch = []
+                    for i in chunk:
+                        meta = int(snap["meta"][i])
+                        alg = meta & 1
+                        eff = max(int(snap["eff_ms"][i]), 1)
+                        batch.append(peers_pb.UpdatePeerGlobal(
+                            key_hash=int(keys[i]), eff_ms=eff,
+                            algorithm=alg,
+                            duration=int(snap["duration"][i]),
+                            created_at=int(snap["t_ms"][i]),
+                            burst=int(snap["burst"][i]),
+                            update=pb.RateLimitResp(
+                                status=(meta >> 1) & 1,
+                                limit=int(snap["limit"][i]),
+                                # RAW internal value — for leaky that is
+                                # td fixed point; the receiver detects
+                                # eff_ms>0 and skips the rescale, so the
+                                # transfer is lossless
+                                remaining=int(snap["remaining"][i]),
+                                reset_time=int(snap["expire_at"][i]))))
+                    delivered = False
+                    for attempt in range(3):
+                        try:
+                            peer.update_peer_globals(batch)
+                            delivered = True
+                            break
+                        except Exception as e:  # noqa: BLE001
+                            # a first RPC to a just-joined peer can
+                            # exceed its deadline while that daemon
+                            # compiles its upsert program; the upsert is
+                            # idempotent, so retrying is safe
+                            log.warning("handover to %s failed "
+                                        "(attempt %d/3): %s", addr,
+                                        attempt + 1, e)
+                            time.sleep(0.5 * (attempt + 1))
+                    if not delivered:
+                        continue  # row stays: reset-on-rehome fallback
+                    with self._engine_mu:
+                        self.engine.remove_rows(
+                            np.asarray([int(keys[i]) for i in chunk],
+                                       np.uint64))
+                    sent += len(chunk)
+            log.info("handover: moved %d rows to %d peers", sent,
+                     len(moved))
 
     def peers(self) -> List[PeerClient]:
         with self._peer_mu:
@@ -846,8 +984,12 @@ class V1Instance:
         from .hashing import hash_keys
 
         # identity = hash(name + "_" + unique_key) and g.key IS that
-        # joined string — one native batch hash instead of m scalar ones
+        # joined string — one native batch hash instead of m scalar
+        # ones.  Handover senders only hold the hash and send it in the
+        # extension field (peers.proto › key_hash); it takes precedence.
         khash = hash_keys([g.key for g in updates])
+        sent_kh = np.fromiter((g.key_hash for g in updates), np.uint64, m)
+        khash = np.where(sent_kh != 0, sent_kh, khash)
         cols = {
             "meta": np.zeros(m, np.int32),
             "limit": np.zeros(m, np.int64),
@@ -860,7 +1002,11 @@ class V1Instance:
         }
         for j, g in enumerate(updates):
             alg = int(g.algorithm)
-            if g.behavior & Behavior.DURATION_IS_GREGORIAN:
+            if g.eff_ms > 0:
+                # handover extension: the sender knows the exact
+                # denominator (including Gregorian rows')
+                eff = int(g.eff_ms)
+            elif g.behavior & Behavior.DURATION_IS_GREGORIAN:
                 try:
                     eff = gregorian_rate_duration_ms(int(g.duration))
                 except (ValueError, KeyError):
@@ -869,7 +1015,11 @@ class V1Instance:
                 eff = max(int(g.duration), 1)
             burst = int(g.burst) if g.burst > 0 else int(g.update.limit)
             if alg == int(Algorithm.LEAKY_BUCKET):
-                rem = int(g.update.remaining) * eff
+                # broadcasts carry whole tokens (× eff to td); handover
+                # messages (eff_ms set) carry the raw td fixed point —
+                # lossless across the hop
+                rem = (int(g.update.remaining) if g.eff_ms > 0
+                       else int(g.update.remaining) * eff)
                 expire = int(g.created_at) + eff
             else:
                 rem = int(g.update.remaining)

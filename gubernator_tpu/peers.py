@@ -69,7 +69,15 @@ class ConsistentHash(Generic[P]):
     def get(self, key: str) -> P:
         if not self._peers:
             raise RuntimeError("picker has no peers")
-        h = self._hash(key.encode("utf-8"))
+        return self.get_by_hash(self._hash(key.encode("utf-8")))
+
+    def get_by_hash(self, h: int) -> P:
+        """Owner for an already-hashed key (stateful handover routes by
+        the table's 64-bit key hashes; valid only when this picker uses
+        the default mixed_fnv1a64 — the same pipeline hashing.hash_key
+        applies to build them)."""
+        if not self._peers:
+            raise RuntimeError("picker has no peers")
         return self._peers[h % len(self._peers)]
 
 
@@ -118,7 +126,13 @@ class ReplicatedConsistentHash(Generic[P]):
     def get(self, key: str) -> P:
         if not self._ring:
             raise RuntimeError("picker has no peers")
-        h = self._hash(key.encode("utf-8"))
+        return self.get_by_hash(self._hash(key.encode("utf-8")))
+
+    def get_by_hash(self, h: int) -> P:
+        """Owner for an already-hashed key (see ConsistentHash
+        .get_by_hash for the hash-pipeline caveat)."""
+        if not self._ring:
+            raise RuntimeError("picker has no peers")
         idx = bisect.bisect_left(self._ring, h)
         if idx == len(self._ring):
             idx = 0
@@ -160,15 +174,22 @@ class RegionPeerPicker(Generic[P]):
         picker = self.regions.get(info.datacenter or self.local_dc)
         return picker.get_by_peer_info(info) if picker else None  # type: ignore
 
-    def get(self, key: str) -> P:
+    def _local_picker(self):
+        """The local region's picker, or any region's as a degraded
+        fallback — the single place the fallback policy lives."""
         picker = self.regions.get(self.local_dc)
         if picker is None:
-            # no local-region peers: fall back to any region (degraded)
             for picker in self.regions.values():
                 break
             else:
                 raise RuntimeError("picker has no peers")
-        return picker.get(key)  # type: ignore
+        return picker
+
+    def get(self, key: str) -> P:
+        return self._local_picker().get(key)  # type: ignore
+
+    def get_by_hash(self, h: int) -> P:
+        return self._local_picker().get_by_hash(h)  # type: ignore
 
     def get_in_region(self, key: str, dc: str) -> Optional[P]:
         picker = self.regions.get(dc)

@@ -1,0 +1,93 @@
+"""Stateful re-sharding (beyond-reference, opt-in): on membership
+change, rows whose ring owner moved are handed to the new owner over
+the peer wire instead of resetting (the reference loses re-homed state
+— SURVEY.md §5.3; ARCHITECTURE.md §6)."""
+import time
+
+import pytest
+
+from gubernator_tpu.client import Client
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.netutil import free_port
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.types import RateLimitRequest, Status
+
+N_KEYS = 40
+
+
+def mk_daemon(mesh, handover=True):
+    return spawn_daemon(DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address="",
+        cache_size=1 << 10,
+        handover_on_reshard=handover), mesh=mesh)
+
+
+def req(i, hits=1):
+    return RateLimitRequest(name="ho", unique_key=f"k{i}", hits=hits,
+                            limit=10, duration=600_000)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(n=2)
+
+
+def _remaining_via(daemon, i):
+    with Client(f"127.0.0.1:{daemon.grpc_port}") as c:
+        return c.get_rate_limits([req(i, hits=0)])[0].remaining
+
+
+def test_join_hands_over_moved_rows(mesh):
+    d1 = mk_daemon(mesh)
+    d2 = None
+    try:
+        with Client(f"127.0.0.1:{d1.grpc_port}") as c:
+            rs = c.get_rate_limits([req(i, hits=3) for i in range(N_KEYS)])
+            assert all(r.error == "" and int(r.status) == 0 for r in rs)
+            # hits=3 per key → remaining 7 everywhere
+            assert {r.remaining for r in rs} == {7}
+        d2 = mk_daemon(mesh)
+        infos = [d1.peer_info(), d2.peer_info()]
+        d1.set_peers(infos)
+        d2.set_peers(infos)
+        # some keys now belong to d2; without handover they'd read 10
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            vals = [_remaining_via(d1, i) for i in range(N_KEYS)]
+            if all(v == 7 for v in vals):
+                break
+            time.sleep(0.2)
+        assert all(v == 7 for v in vals), vals
+        # and d2 genuinely holds some of them now (handover, not
+        # forwarding trickery): its own engine answers for moved keys
+        from gubernator_tpu.core.table import occupancy
+
+        assert int(occupancy(d2.instance.engine.state)) > 0
+        # d1 dropped what it handed over
+        assert int(occupancy(d1.instance.engine.state)) < N_KEYS
+    finally:
+        d1.close()
+        if d2 is not None:
+            d2.close()
+
+
+def test_join_without_handover_resets_moved_rows(mesh):
+    """The reference behavior (and our default): re-homed keys reset."""
+    d1 = mk_daemon(mesh, handover=False)
+    d2 = None
+    try:
+        with Client(f"127.0.0.1:{d1.grpc_port}") as c:
+            c.get_rate_limits([req(i, hits=3) for i in range(N_KEYS)])
+        d2 = mk_daemon(mesh, handover=False)
+        infos = [d1.peer_info(), d2.peer_info()]
+        d1.set_peers(infos)
+        d2.set_peers(infos)
+        vals = [_remaining_via(d1, i) for i in range(N_KEYS)]
+        # moved keys read fresh (10), kept keys read 7 — both present
+        assert 10 in vals and 7 in vals, vals
+    finally:
+        d1.close()
+        if d2 is not None:
+            d2.close()

@@ -274,13 +274,16 @@ class ShardedEngine:
         sharded arrays — no collective).  The eviction analog of the
         reference's LRU + expired-entry handling (lrucache.go).
 
-        With GUBER_PALLAS_SWEEP=1 the fused Pallas kernel runs instead
-        (same semantics + live count in one streaming pass; see
-        ops/pallas_sweep.py)."""
+        The fused Pallas kernel (same semantics + live count in one
+        streaming pass, validated bit-exact on v5e; ops/pallas_sweep.py)
+        runs by default on TPU backends; GUBER_PALLAS_SWEEP=1/0 forces
+        it on/off (off-TPU it would run in the slow interpret mode)."""
         import os
 
-        if os.environ.get("GUBER_PALLAS_SWEEP") == "1" and \
-                self.cap_local % 1024 == 0:
+        use_pallas = os.environ.get(
+            "GUBER_PALLAS_SWEEP",
+            "1" if jax.default_backend() == "tpu" else "0") == "1"
+        if use_pallas and self.cap_local % 1024 == 0:
             self.state, live = self._pallas_sweep(now_ms)
             self.live_rows = int(live)
         else:

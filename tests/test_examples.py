@@ -15,3 +15,9 @@ def test_embedded_engine_example(capsys):
     runpy.run_path("examples/embedded_engine.py", run_name="__main__")
     out = capsys.readouterr().out
     assert "decisions in" in out
+
+
+def test_global_hotset_example():
+    import runpy
+
+    runpy.run_path("examples/global_hotset.py", run_name="__main__")

@@ -98,3 +98,45 @@ def test_concurrent_clients_conserve_hits():
     # 8×30 = 240 attempts against capacity 100: exactly 100 admitted
     assert sum(admitted) == 100
     inst.close()
+
+
+def test_concurrent_wire_clients_conserve_hits():
+    """The C++ wire lane under threaded load: coalesced packed jobs in
+    the dispatcher must conserve hits exactly like the object path."""
+    import pytest
+
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance, _wire_native
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.wire import req_to_pb
+
+    if _wire_native is None:  # pragma: no cover
+        pytest.skip("native extension not built")
+    inst = V1Instance(Config(cache_size=1 << 10, sweep_interval_ms=0),
+                      mesh=make_mesh(n=2))
+    m = pb.GetRateLimitsReq()
+    m.requests.extend(req_to_pb(RateLimitRequest(
+        name="conserve", unique_key="wire", hits=1, limit=100,
+        duration=600_000)) for _ in range(5))
+    data = m.SerializeToString()
+    admitted = []
+    lock = threading.Lock()
+
+    def worker(w):
+        got = 0
+        for _ in range(10):
+            out = pb.GetRateLimitsResp.FromString(
+                inst.get_rate_limits_wire(data, now_ms=NOW))
+            got += sum(1 for r in out.responses if int(r.status) == 0)
+        with lock:
+            admitted.append(got)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 6×10×5 = 300 attempts against capacity 100: exactly 100 admitted
+    assert sum(admitted) == 100
+    inst.close()

@@ -287,10 +287,26 @@ class ShardedEngine:
             self.state, live = self._pallas_sweep(now_ms)
             self.live_rows = int(live)
         else:
-            from ..core.table import sweep_expired
+            from ..core.table import occupancy, sweep_expired
 
             self.state = sweep_expired(self.state, np.int64(now_ms))
+            if self.auto_grow_limit:
+                self.live_rows = int(occupancy(self.state))
         self.sweep_count += 1
+        # Proactive growth: open-addressing probe windows start
+        # exhausting on unlucky keys well before the table is full
+        # (~2% per insert at 60% load with 8 probes), so with auto-grow
+        # enabled double capacity once LIVE occupancy crosses 60% on
+        # the sweep tick — off the serving path, so request latency
+        # never pays for the grow (reactive growth in check_* stays as
+        # the backstop when traffic outruns the sweep interval).
+        if (self.auto_grow_limit
+                and self.cap_local * 2 <= self.auto_grow_limit
+                and self.live_rows > 0.6 * self.cap_local * self.n):
+            dropped = self.grow(self.cap_local * 2)
+            if dropped:
+                log.warning("proactive grow to %d/shard dropped %d "
+                            "live rows", self.cap_local, dropped)
 
     def _pallas_sweep(self, now_ms: int):
         """shard_map'd fused sweep: per-shard Pallas pass + psum'd live

@@ -139,6 +139,37 @@ class TestOnDeviceGrow:
         assert not full.any()
         assert eng2.cap_local > 1 << 6
 
+    def test_proactive_grow_on_sweep_at_high_occupancy(self):
+        import numpy as np
+
+        from gubernator_tpu.hashing import hash_request_keys
+
+        eng = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
+                            batch_per_shard=64,
+                            auto_grow_limit=1 << 12)
+        # place ~68% live occupancy directly (upsert_rows never grows,
+        # so this models traffic that built up between sweep ticks)
+        n = 700
+        kh = hash_request_keys(["shard"] * n,
+                               [f"pg{i}" for i in range(n)])
+        cols = {"meta": np.zeros(n, np.int32),
+                "limit": np.full(n, 10, np.int64),
+                "duration": np.full(n, 10**7, np.int64),
+                "eff_ms": np.full(n, 10**7, np.int64),
+                "burst": np.full(n, 10, np.int64),
+                "remaining": np.full(n, 9, np.int64),
+                "t_ms": np.full(n, NOW, np.int64),
+                "expire_at": np.full(n, NOW + 10**7, np.int64)}
+        placed = eng.upsert_rows(kh, cols)
+        assert placed > 0.6 * eng.cap_local * eng.n
+        cap0 = eng.cap_local
+        eng.sweep(NOW + 1)
+        assert eng.cap_local == cap0 * 2  # grew off the serving path
+        found, got = eng.gather_rows(kh[:placed])
+        # rows survive the proactive reshard with their values
+        assert found.sum() >= placed - 5  # minus any upsert dup drops
+        assert (got["remaining"][found] == 9).all()
+
     def test_grow_is_device_resident(self):
         # the whole point: no host column staging — state stays sharded
         eng = ShardedEngine(make_mesh(n=4), capacity_per_shard=1 << 8,

@@ -34,7 +34,6 @@ from .store import CacheItem
 from .types import (
     Algorithm,
     Behavior,
-    GregorianDuration,
     HealthCheckResponse,
     MAX_BATCH_SIZE,
     PeerInfo,

@@ -29,7 +29,17 @@ MAX_INPUT = (1 << 31) - 1
 
 
 class RequestBatch(NamedTuple):
-    """Fixed-shape [B] device view of a GetRateLimitsReq batch."""
+    """Fixed-shape [B] device view of a GetRateLimitsReq batch.
+
+    ``now`` is per-request arrival time (epoch ms) — the device honors
+    it per position, so batches packed at different wall-clock instants
+    coalesce into one launch without quantizing time (the reference's
+    sequential loop also reads the clock per request).  The packers
+    always fill it; None (or a 0 entry) falls back to the scalar
+    ``now_ms`` argument in ``decide_batch_impl`` ONLY — the serving
+    paths (check_packed / check_columns / pack_wave_host) require the
+    column.
+    """
 
     key: jax.Array | np.ndarray  # uint64, 0 = padding
     hits: jax.Array | np.ndarray  # int64, clamped ≥ 0
@@ -41,6 +51,7 @@ class RequestBatch(NamedTuple):
     algorithm: jax.Array | np.ndarray  # int32
     burst: jax.Array | np.ndarray  # int64, already defaulted to limit
     valid: jax.Array | np.ndarray  # bool
+    now: jax.Array | np.ndarray | None = None  # int64 epoch ms, 0 = unset
 
 
 def bucket_size(n: int) -> int:
@@ -62,6 +73,7 @@ def empty_batch(size: int) -> RequestBatch:
         algorithm=np.zeros(size, np.int32),
         burst=np.zeros(size, np.int64),
         valid=np.zeros(size, bool),
+        now=np.zeros(size, np.int64),
     )
 
 
@@ -89,6 +101,7 @@ def pack_requests(
     b.key[:n] = key_hashes if key_hashes is not None else hash_keys(
         [r.key for r in reqs])
     GREG = int(Behavior.DURATION_IS_GREGORIAN)  # hot loop: plain-int flags
+    b.now[:n] = now_ms
     for i, r in enumerate(reqs):
         behavior = int(r.behavior)
         duration = min(int(r.duration), MAXI)
@@ -150,6 +163,7 @@ def pack_columns(
         algorithm=(algorithm == 1).astype(np.int32),
         burst=np.where(burst > 0, np.minimum(burst, MAXI), lim),
         valid=np.ones(n, bool),
+        now=np.full(n, now_ms, np.int64),
     )
     errors: dict = {}
     greg = (b.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0

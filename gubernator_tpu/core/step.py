@@ -83,6 +83,7 @@ class _Req(NamedTuple):
     behavior: jax.Array
     alg: jax.Array
     burst: jax.Array
+    now: jax.Array  # per-request arrival time (epoch ms)
 
 
 def _probe_slots(key: jax.Array, cap: int) -> jax.Array:
@@ -150,11 +151,18 @@ def _insert(tkey: jax.Array, slots: jax.Array, key: jax.Array,
     return tkey, row, n_claimed
 
 
-def _apply_position(item: _Item, req: _Req, now: jax.Array):
+def _apply_position(item: _Item, req: _Req):
     """One request applied to its item — the full §2.4 transition,
-    vectorized across segments.  Mirrors oracle.apply_token/apply_leaky
-    exactly (same operation order, same integer arithmetic)."""
+    vectorized across segments, at the request's OWN arrival time
+    (req.now).  Mirrors oracle.apply_token/apply_leaky exactly (same
+    operation order, same integer arithmetic).
+
+    Time is clamped per key to never run backward (max with the item's
+    clock): a no-op on monotonic streams (where oracle parity is
+    asserted), and a sane defined behavior when merged callers' clocks
+    invert — without it a leaky replenish would see negative elapsed."""
     i64 = jnp.int64
+    now = jnp.maximum(req.now, item.t)
     is_leaky = req.alg == int(Algorithm.LEAKY_BUCKET)
     is_greg = (req.behavior & _GREG) != 0
     reset = (req.behavior & _RESET) != 0
@@ -253,6 +261,13 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     key = batch.key
     valid = batch.valid & (key != 0)
+    # per-request arrival time; 0 entries (padding / legacy callers
+    # without the column) fall back to the scalar argument
+    if batch.now is None:
+        now_col = jnp.full((B,), now, i64)
+    else:
+        now_col = jnp.where(jnp.asarray(batch.now, i64) > 0,
+                            jnp.asarray(batch.now, i64), now)
 
     # ---- probe / insert -------------------------------------------------
     slots = _probe_slots(key, cap)
@@ -272,8 +287,16 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     err = valid & (row < 0)  # probe window exhausted: table overfull
     row = jnp.where(valid & (row >= 0), row, cap)  # cap = dropped sentinel
 
-    # ---- sort into segments (stable keeps request order within key) ----
-    perm = jnp.argsort(row, stable=True)
+    # ---- sort into segments ordered by (row, now, original index) ----
+    # Two stable sorts = lexicographic: within a key's segment, requests
+    # apply in arrival-time order (then original order) — sequential
+    # parity even when the dispatcher merges batches from callers whose
+    # clocks differ (the oracle, like the reference's sequential loop,
+    # assumes per-key time-monotonic application; a time-inverted leaky
+    # replenish would see negative elapsed).  Uniform-now batches reduce
+    # to the original stable-by-row order.
+    perm0 = jnp.argsort(now_col, stable=True)
+    perm = perm0[jnp.argsort(row[perm0], stable=True)]
     r_s = row[perm]
     head = jnp.concatenate([jnp.ones(1, bool), r_s[1:] != r_s[:-1]])
     seg_id = (jnp.cumsum(head) - 1).astype(i32)
@@ -289,13 +312,15 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
         duration=batch.duration[perm], eff=batch.eff_ms[perm],
         greg_end=batch.greg_end[perm], behavior=batch.behavior[perm],
         alg=batch.algorithm[perm], burst=batch.burst[perm],
+        now=now_col[perm],
     )
 
     def uni(x):
         return seg_max(x) == seg(x)
 
     uniform = (uni(sf.hits) & uni(sf.limit) & uni(sf.duration) & uni(sf.eff)
-               & uni(sf.behavior) & uni(sf.alg) & uni(sf.burst))
+               & uni(sf.behavior) & uni(sf.alg) & uni(sf.burst)
+               & uni(sf.now))  # mixed arrival times → per-position path
     any_flag = seg_max((sf.behavior & (_RESET | _DRAIN))) > 0
     simple = exists & uniform & (~any_flag)
     complex_seg = exists & (seg_len > 1) & (~simple)
@@ -320,7 +345,7 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     req0 = _Req(*[greq(f) for f in sf])
 
-    item1, out0 = _apply_position(item0, req0, now)
+    item1, out0 = _apply_position(item0, req0)
     item1 = _tree_where(exists, item1, item0)
 
     # ---- simple tails: closed form, fully vectorized -------------------
@@ -377,7 +402,7 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
         idxj = jnp.where(complex_seg & (j < seg_len), seg_start + j, B).astype(i32)
         reqj = _Req(*[x.at[idxj].get(mode="fill", fill_value=0) for x in sf])
         m = complex_seg & (j < seg_len)
-        item2, outj = _apply_position(item, reqj, now)
+        item2, outj = _apply_position(item, reqj)
         item = _tree_where(m, item2, item)
         os_ = os_.at[idxj].set(outj[0], mode="drop")
         or_ = or_.at[idxj].set(outj[1], mode="drop")

@@ -131,17 +131,29 @@ class Dispatcher:
             wave = self._drain_wave()
             if not wave:
                 continue
-            # group by caller timestamp: merging must not rewrite an
-            # explicit now_ms (deterministic tests, replayed traffic)
+            # Packed jobs carry per-request arrival times in their `now`
+            # column, so they ALL merge into one launch regardless of
+            # wall-clock skew between callers — the device honors each
+            # request's own time.  List jobs still group by timestamp
+            # (pack_requests bakes one now per job, incl. Gregorian
+            # period ends).  Execution units run in ascending-now order
+            # so a list job never applies BEHIND a packed launch that
+            # already advanced a shared key's clock (the step clamps
+            # per-key time as the final defense).
+            packed = [j for j in wave if isinstance(j, _PackedJob)]
             by_now: dict = {}
             for j in wave:
-                by_now.setdefault(j.now_ms, []).append(j)
-            for now in sorted(by_now):
-                jobs = by_now[now]
-                self._run_list_jobs([j for j in jobs
-                                     if isinstance(j, _Job)], now)
-                self._run_packed_jobs([j for j in jobs
-                                       if isinstance(j, _PackedJob)], now)
+                if isinstance(j, _Job):
+                    by_now.setdefault(j.now_ms, []).append(j)
+            units = [(now, "list", jobs) for now, jobs in by_now.items()]
+            if packed:
+                units.append((min(j.now_ms for j in packed), "packed",
+                              packed))
+            for now, kind, jobs in sorted(units, key=lambda u: u[0]):
+                if kind == "list":
+                    self._run_list_jobs(jobs, now)
+                else:
+                    self._run_packed_jobs(jobs)
 
     def _run_list_jobs(self, jobs, now) -> None:
         if not jobs:
@@ -162,7 +174,7 @@ class Dispatcher:
                 if not j.future.done():
                     j.future.set_exception(e)
 
-    def _run_packed_jobs(self, jobs, now) -> None:
+    def _run_packed_jobs(self, jobs) -> None:
         if not jobs:
             return
         import numpy as np
@@ -175,6 +187,9 @@ class Dispatcher:
                     np.concatenate([np.asarray(j.batch[f]) for j in jobs])
                     for f in range(len(jobs[0].batch))])
                 khash = np.concatenate([j.khash for j in jobs])
+            # scalar now only backstops sweeps/padding; requests use
+            # their own now column.  max() keeps sweep time monotonic.
+            now = max(j.now_ms for j in jobs)
             with self._engine_lock:
                 cols = self.engine.check_packed(batch, khash, now)
             a = 0

@@ -98,7 +98,7 @@ def make_hot_step(mesh):
         bt = RequestBatch(
             key=lax.bitcast_convert_type(a64[0], jnp.uint64),
             hits=a64[1], limit=a64[2], duration=a64[3], eff_ms=a64[4],
-            greg_end=a64[5], burst=a64[6],
+            greg_end=a64[5], burst=a64[6], now=a64[7],
             behavior=a32[0], algorithm=a32[1], valid=a32[2] != 0)
         st, out = decide_batch_impl(st, bt, now)
         st = jax.tree.map(lambda x: x[None], st)

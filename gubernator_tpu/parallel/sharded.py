@@ -184,18 +184,19 @@ def make_sharded_step(mesh):
 
 
 #: Packed-transfer wire layout for the serving step: every RequestBatch
-#: int64 column rides one [7, B] int64 upload (key bit-viewed), the
-#: int32/bool columns one [3, B] int32 upload, and all five outputs one
-#: [5, B] int64 download.  A device call then costs 2 uploads + 1
-#: download instead of 10 + 5 — per-transfer latency (PCIe doorbells, or
-#: milliseconds over a tunneled link) dominates these tiny arrays, not
-#: bandwidth.
-PACK64 = ("key", "hits", "limit", "duration", "eff_ms", "greg_end", "burst")
+#: int64 column rides one [8, B] int64 upload (key bit-viewed; row 7 is
+#: the per-request arrival time), the int32/bool columns one [3, B]
+#: int32 upload, and all five outputs one [5, B] int64 download.  A
+#: device call then costs 2 uploads + 1 download instead of 10 + 5 —
+#: per-transfer latency (PCIe doorbells, or milliseconds over a
+#: tunneled link) dominates these tiny arrays, not bandwidth.
+PACK64 = ("key", "hits", "limit", "duration", "eff_ms", "greg_end",
+          "burst", "now")
 PACK32 = ("behavior", "algorithm", "valid")
 
 
 def pack_wave_host(b: RequestBatch) -> tuple[np.ndarray, np.ndarray]:
-    """RequestBatch of numpy columns → ([7,B] i64, [3,B] i32)."""
+    """RequestBatch of numpy columns → ([8,B] i64, [3,B] i32)."""
     B = len(b.key)
     a64 = np.empty((len(PACK64), B), np.int64)
     a64[0] = np.asarray(b.key).view(np.int64)
@@ -218,7 +219,7 @@ def make_sharded_step_packed(mesh):
         batch = RequestBatch(
             key=lax.bitcast_convert_type(a64[0], jnp.uint64),
             hits=a64[1], limit=a64[2], duration=a64[3], eff_ms=a64[4],
-            greg_end=a64[5], burst=a64[6],
+            greg_end=a64[5], burst=a64[6], now=a64[7],
             behavior=a32[0], algorithm=a32[1], valid=a32[2] != 0)
         state, out = decide_batch_impl(state, batch, now)
         packed = jnp.stack([
@@ -439,7 +440,11 @@ class ShardedEngine:
         rst_o = np.zeros(n, np.int64)
         lim_o = np.zeros(n, np.int64)
         full = np.zeros(n, bool)
-        pending = np.arange(n)
+        now_col = np.asarray(batch.now)
+        # earliest requests take the earliest waves: same-key requests
+        # split across waves then apply in arrival-time order (within a
+        # wave the device's (row, now) sort handles it)
+        pending = np.argsort(now_col, kind="stable")
         retried = False
         while len(pending):
             shard = shard_of(khash[pending], self.n)

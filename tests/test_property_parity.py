@@ -75,3 +75,48 @@ def test_oracle_invariants(req, dt):
     # must never DECREASE remaining
     for k, item in o.items.items():
         assert item.remaining >= frozen[k]["remaining"]
+
+
+@settings(max_examples=20, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(st.tuples(st.lists(_request, min_size=1, max_size=12),
+                          st.integers(0, 5_000)),
+                min_size=2, max_size=4))
+def test_merged_cross_time_batch_matches_sequential_oracle(jobs):
+    """One engine launch holding several jobs packed at DIFFERENT times
+    (per-request now column) must equal sequential per-time application
+    — including RESET/DRAIN flags and algorithm mixes on keys whose
+    requests straddle instants (the while_loop path with non-uniform
+    now)."""
+    import numpy as np
+
+    from gubernator_tpu.core.batch import pack_requests
+    from gubernator_tpu.hashing import hash_request_keys
+
+    eng = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+    oracle = Oracle()
+    now = NOW
+    packed_parts = []
+    want_parts = []
+    for reqs, dt in jobs:
+        now += dt
+        kh = hash_request_keys([r.name for r in reqs],
+                               [r.unique_key for r in reqs])
+        b, errs = pack_requests(reqs, now, size=len(reqs), key_hashes=kh)
+        assert not any(errs)
+        packed_parts.append((b, kh))
+        want_parts.append(oracle.check_batch(reqs, now))
+    batch = type(packed_parts[0][0])(*[
+        np.concatenate([np.asarray(p[0][f]) for p in packed_parts])
+        for f in range(len(packed_parts[0][0]))])
+    khash = np.concatenate([p[1] for p in packed_parts])
+    st_, lim, rem, rst, full = eng.check_packed(batch, khash, now)
+    assert not full.any()
+    g = 0
+    for (reqs, _), want in zip(jobs, want_parts):
+        for i, w in enumerate(want):
+            assert (int(st_[g]), int(rem[g]), int(rst[g]), int(lim[g])) \
+                == (int(w.status), w.remaining, w.reset_time, w.limit), \
+                (g, i, reqs[i])
+            g += 1

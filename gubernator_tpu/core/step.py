@@ -293,10 +293,19 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     # parity even when the dispatcher merges batches from callers whose
     # clocks differ (the oracle, like the reference's sequential loop,
     # assumes per-key time-monotonic application; a time-inverted leaky
-    # replenish would see negative elapsed).  Uniform-now batches reduce
-    # to the original stable-by-row order.
-    perm0 = jnp.argsort(now_col, stable=True)
-    perm = perm0[jnp.argsort(row[perm0], stable=True)]
+    # replenish would see negative elapsed).  Uniform-now batches — the
+    # common case: any unmerged call — take the single-sort branch;
+    # lax.cond executes only the taken side, so the extra sort costs
+    # nothing unless instants actually mixed.
+    def _sort_single(_):
+        return jnp.argsort(row, stable=True)
+
+    def _sort_by_time(_):
+        p0 = jnp.argsort(now_col, stable=True)
+        return p0[jnp.argsort(row[p0], stable=True)]
+
+    perm = lax.cond(jnp.all(now_col == now_col[0]),
+                    _sort_single, _sort_by_time, None)
     r_s = row[perm]
     head = jnp.concatenate([jnp.ones(1, bool), r_s[1:] != r_s[:-1]])
     seg_id = (jnp.cumsum(head) - 1).astype(i32)

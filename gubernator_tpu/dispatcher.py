@@ -33,6 +33,16 @@ def _job_len(job) -> int:
     return (len(job.reqs) if isinstance(job, _Job) else len(job.khash))
 
 
+def _concat_columns(parts):
+    """[(RequestBatch, khash), ...] → one concatenated (batch, khash)."""
+    import numpy as np
+
+    batch = type(parts[0][0])(*[
+        np.concatenate([np.asarray(b[f]) for b, _ in parts])
+        for f in range(len(parts[0][0]))])
+    return batch, np.concatenate([kh for _, kh in parts])
+
+
 class _Job:
     __slots__ = ("reqs", "now_ms", "future")
 
@@ -149,11 +159,76 @@ class Dispatcher:
             if packed:
                 units.append((min(j.now_ms for j in packed), "packed",
                               packed))
+            if (len(units) > 1 and by_now
+                    and hasattr(self.engine, "check_packed")):
+                # several instants in one wave: pack each list job at
+                # its own now and merge EVERYTHING into the packed
+                # launch — per-request time makes quantization
+                # unnecessary (single-unit waves keep the object lane's
+                # zero-repack path)
+                try:
+                    self._run_merged_wave(wave)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    for j in wave:
+                        if not j.future.done():
+                            j.future.set_exception(e)
+                    continue
             for now, kind, jobs in sorted(units, key=lambda u: u[0]):
                 if kind == "list":
                     self._run_list_jobs(jobs, now)
                 else:
                     self._run_packed_jobs(jobs)
+
+    def _run_merged_wave(self, wave) -> None:
+        """Cross-time merge of a mixed wave: every list job is packed at
+        its own now (Gregorian period ends are per-instant), columns
+        concatenate with the packed jobs, and ONE launch serves all —
+        the device applies each key's requests in arrival-time order."""
+        import numpy as np
+
+        from .core.batch import pack_requests
+        from .hashing import hash_request_keys
+        from .types import RateLimitResponse, Status
+
+        parts = []  # (job, batch, khash, errs or None)
+        for j in wave:
+            if isinstance(j, _PackedJob):
+                parts.append((j, j.batch, j.khash, None))
+            else:
+                kh = hash_request_keys([r.name for r in j.reqs],
+                                       [r.unique_key for r in j.reqs])
+                b, errs = pack_requests(j.reqs, j.now_ms,
+                                        size=len(j.reqs), key_hashes=kh)
+                parts.append((j, b, kh, errs))
+        batch, khash = _concat_columns([(p[1], p[2]) for p in parts])
+        now = max(j.now_ms for j in wave)
+        with self._engine_lock:
+            st, lim, rem, rst, full = self.engine.check_packed(
+                batch, khash, now)
+        a = 0
+        for j, _, kh, errs in parts:
+            b_ = a + len(kh)
+            if isinstance(j, _PackedJob):
+                j.future.set_result((st[a:b_], lim[a:b_], rem[a:b_],
+                                     rst[a:b_], full[a:b_]))
+            else:
+                resps = []
+                for i in range(len(kh)):
+                    g = a + i
+                    if errs and errs[i]:
+                        resps.append(RateLimitResponse(error=errs[i]))
+                    elif full[g]:
+                        resps.append(RateLimitResponse(
+                            error="rate limit table full"))
+                    else:
+                        resps.append(RateLimitResponse(
+                            status=Status.OVER_LIMIT if st[g]
+                            else Status.UNDER_LIMIT,
+                            limit=int(lim[g]), remaining=int(rem[g]),
+                            reset_time=int(rst[g])))
+                j.future.set_result(resps)
+            a = b_
 
     def _run_list_jobs(self, jobs, now) -> None:
         if not jobs:
@@ -183,10 +258,8 @@ class Dispatcher:
             if len(jobs) == 1:
                 batch, khash = jobs[0].batch, jobs[0].khash
             else:
-                batch = type(jobs[0].batch)(*[
-                    np.concatenate([np.asarray(j.batch[f]) for j in jobs])
-                    for f in range(len(jobs[0].batch))])
-                khash = np.concatenate([j.khash for j in jobs])
+                batch, khash = _concat_columns(
+                    [(j.batch, j.khash) for j in jobs])
             # scalar now only backstops sweeps/padding; requests use
             # their own now column.  max() keeps sweep time monotonic.
             now = max(j.now_ms for j in jobs)

@@ -193,3 +193,95 @@ def test_dispatcher_merges_packed_jobs_across_nows():
     assert launches[0] == 4  # the blocked first job
     assert launches[1:] == [8]  # jobs 2 and 3 merged despite nows
     disp.close()
+
+
+def test_mixed_wave_cross_now_merges_list_and_packed_jobs():
+    """A wave holding object-lane jobs at different nows plus a packed
+    job merges into one launch, with exact sequential-oracle results."""
+    import threading
+
+    import numpy as np
+
+    from gubernator_tpu import Oracle, RateLimitRequest
+    from gubernator_tpu.core.batch import pack_columns
+    from gubernator_tpu.dispatcher import Dispatcher
+    from gubernator_tpu.hashing import hash_request_keys
+    from gubernator_tpu.parallel import ShardedEngine, make_mesh
+
+    NOW = 1_779_000_000_000
+    eng = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
+                        batch_per_shard=64)
+    launches = []
+    release = threading.Event()
+    orig_cp = eng.check_packed
+    orig_cb = eng.check_batch
+
+    def gated_cp(batch, kh, now):
+        release.wait(timeout=30)
+        launches.append(("packed", len(kh)))
+        return orig_cp(batch, kh, now)
+
+    def gated_cb(reqs_, now):
+        release.wait(timeout=30)
+        launches.append(("list", len(reqs_)))
+        return orig_cb(reqs_, now)
+
+    eng.check_packed = gated_cp
+    eng.check_batch = gated_cb
+    disp = Dispatcher(eng, max_delay_ms=0.2)
+
+    def reqs(tag):
+        return [RateLimitRequest(name="mw", unique_key=f"k{i % 3}",
+                                 hits=1, limit=50, duration=60_000)
+                for i in range(6)]
+
+    def packed_cols(now):
+        kh = hash_request_keys(["mw"] * 6, [f"k{i % 3}" for i in range(6)])
+        b, _ = pack_columns(kh, np.ones(6, np.int64),
+                            np.full(6, 50, np.int64),
+                            np.full(6, 60_000, np.int64),
+                            np.zeros(6, np.int32), np.zeros(6, np.int32),
+                            np.zeros(6, np.int64), now)
+        return b, kh
+
+    results = {}
+    # job 0 blocks the dispatcher inside the engine; the rest queue up
+    threads = [threading.Thread(
+        target=lambda: results.setdefault(
+            "blocker", disp.check_batch(reqs(0), NOW)))]
+    threads[0].start()
+    import time as _t
+
+    _t.sleep(0.4)
+    threads.append(threading.Thread(
+        target=lambda: results.setdefault(
+            "list1", disp.check_batch(reqs(1), NOW + 1))))
+    threads.append(threading.Thread(
+        target=lambda: results.setdefault(
+            "list2", disp.check_batch(reqs(2), NOW + 2))))
+    b, kh = packed_cols(NOW + 3)
+    threads.append(threading.Thread(
+        target=lambda: results.setdefault(
+            "packed", disp.check_packed(b, kh, NOW + 3))))
+    for t in threads[1:]:
+        t.start()
+    _t.sleep(0.4)
+    release.set()
+    for t in threads:
+        t.join(timeout=60)
+    # blocker launched alone (it held the dispatcher while the rest
+    # queued); the remaining three instants merged into ONE launch
+    assert launches[0] == ("list", 6)
+    assert launches[1:] == [("packed", 18)], launches
+    # exact parity with sequential per-time application
+    oracle = Oracle()
+    want = {t: oracle.check_batch(reqs(0), NOW + t) for t in range(4)}
+    for tag, t in (("blocker", 0), ("list1", 1), ("list2", 2)):
+        got = results[tag]
+        for i, (w, g) in enumerate(zip(want[t], got)):
+            assert (int(g.status), g.remaining) == \
+                (int(w.status), w.remaining), (tag, i)
+    st, lim, rem, rst, full = results["packed"]
+    for i, w in enumerate(want[3]):
+        assert (int(st[i]), int(rem[i])) == (int(w.status), w.remaining)
+    disp.close()

@@ -262,7 +262,10 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
             eff_ms=jnp.full(B2, DURATION_MS, i64),
             greg_end=jnp.zeros(B2, i64), behavior=jnp.zeros(B2, i32),
             algorithm=jnp.zeros(B2, i32), burst=jnp.full(B2, LIMIT, i64),
-            valid=jnp.ones(B2, bool))
+            valid=jnp.ones(B2, bool),
+            # 0 = use the step's scalar now argument (these configs
+            # advance time per call through _sustain)
+            now=jnp.zeros(B2, i64))
         cols.update(over)
         return RequestBatch(key=jnp.asarray(keys), **cols)
 
@@ -368,6 +371,28 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
                 reps * 1000 / (time.perf_counter() - t0))
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["wire_lane_error"] = str(e)[:200]
+        # peer-forwarding path (benchmark_test.go ›
+        # BenchmarkServer_GetPeerRateLimit analog): the owner-side
+        # apply a forwarded batch takes, via its wire lane
+        try:
+            from gubernator_tpu.proto import peers_pb2 as peers_pb
+
+            pdatas = []
+            for rs in reqs5:
+                m = peers_pb.GetPeerRateLimitsReq()
+                m.requests.extend(req_to_pb(r) for r in rs)
+                pdatas.append(m.SerializeToString())
+            inst.get_peer_rate_limits_wire(pdatas[0], now_ms=NOW0 + 200)
+            t0 = time.perf_counter()
+            for r in range(reps):
+                inst.get_peer_rate_limits_wire(pdatas[r % 4],
+                                               now_ms=NOW0 + 201 + r)
+            out["8_peer_path"] = {
+                "decisions_per_s": round(
+                    reps * 1000 / (time.perf_counter() - t0)),
+                "batch": 1000}
+        except Exception as e:  # noqa: BLE001
+            out["8_peer_path"] = {"error": str(e)[:200]}
         inst.close()
     except Exception as e:  # noqa: BLE001
         out["6_service_path"] = {"error": str(e)[:200]}

@@ -189,7 +189,7 @@ class Dispatcher:
 
         from .core.batch import pack_requests
         from .hashing import hash_request_keys
-        from .types import RateLimitResponse, Status
+        from .parallel.sharded import responses_from_columns
 
         parts = []  # (job, batch, khash, errs or None)
         for j in wave:
@@ -213,21 +213,9 @@ class Dispatcher:
                 j.future.set_result((st[a:b_], lim[a:b_], rem[a:b_],
                                      rst[a:b_], full[a:b_]))
             else:
-                resps = []
-                for i in range(len(kh)):
-                    g = a + i
-                    if errs and errs[i]:
-                        resps.append(RateLimitResponse(error=errs[i]))
-                    elif full[g]:
-                        resps.append(RateLimitResponse(
-                            error="rate limit table full"))
-                    else:
-                        resps.append(RateLimitResponse(
-                            status=Status.OVER_LIMIT if st[g]
-                            else Status.UNDER_LIMIT,
-                            limit=int(lim[g]), remaining=int(rem[g]),
-                            reset_time=int(rst[g])))
-                j.future.set_result(resps)
+                j.future.set_result(responses_from_columns(
+                    (st[a:b_], lim[a:b_], rem[a:b_], rst[a:b_],
+                     full[a:b_]), errs))
             a = b_
 
     def _run_list_jobs(self, jobs, now) -> None:

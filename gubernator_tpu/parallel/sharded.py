@@ -156,6 +156,38 @@ def make_grow(mesh, cap_new: int):
         out_specs=(P(SHARD_AXIS), P())))
 
 
+def responses_from_columns(cols, errors=None):
+    """(status, limit, remaining, reset, full) columns + optional
+    per-request error strings → RateLimitResponse objects.  THE response
+    contract, shared by the engine's object lane and the dispatcher's
+    merged-wave path."""
+    st, lim, rem, rst, full = cols
+    # one bulk conversion to Python ints: per-element numpy scalar
+    # indexing costs ~µs each and this loop runs per request
+    st_l = np.asarray(st).tolist()
+    lim_l = np.asarray(lim).tolist()
+    rem_l = np.asarray(rem).tolist()
+    rst_l = np.asarray(rst).tolist()
+    full_l = np.asarray(full).tolist()
+    out: List[RateLimitResponse] = []
+    for i in range(len(st_l)):
+        if errors is not None and errors[i]:
+            out.append(RateLimitResponse(error=errors[i]))
+        elif full_l[i]:
+            # probe window exhausted by LIVE keys even after the sweep
+            # retry (and auto-grow, if enabled) inside check_packed
+            out.append(RateLimitResponse(error="rate limit table full"))
+        else:
+            out.append(RateLimitResponse(
+                # attribute lookup, not Status(...): the enum
+                # constructor costs ~µs and this is per request
+                status=Status.OVER_LIMIT if st_l[i]
+                else Status.UNDER_LIMIT,
+                limit=lim_l[i], remaining=rem_l[i],
+                reset_time=rst_l[i]))
+    return out
+
+
 def make_sharded_step(mesh):
     """jit-compiled sharded step: (state, batch, now) → (state, outputs).
 
@@ -346,82 +378,17 @@ class ShardedEngine:
 
     def check_batch(self, reqs: Sequence[RateLimitRequest], now_ms: int
                     ) -> List[RateLimitResponse]:
-        """Route requests to their owner shards, run waves of the sharded
-        step until all are served, reassemble in request order."""
+        """Object-lane entry: pack, run the columnar path, assemble
+        RateLimitResponse objects.  One wave/retry/auto-grow code path
+        for both lanes (check_packed is the single implementation)."""
         from ..hashing import hash_request_keys
 
-        n = len(reqs)
         khash = hash_request_keys([r.name for r in reqs],
                                   [r.unique_key for r in reqs])
-        shard = shard_of(khash, self.n)
-        responses: List[RateLimitResponse] = [None] * n  # type: ignore
-        pending = list(range(n))
-        retried: set = set()
-        while pending:
-            wave: List[int] = []
-            wave_pos: List[int] = []  # block slot, assigned at admission
-            fill = [0] * self.n
-            rest: List[int] = []
-            grew = [False]  # at most one capacity doubling per wave
-            for i in pending:
-                s = int(shard[i])
-                if fill[s] < self.B:
-                    wave_pos.append(s * self.B + fill[s])
-                    fill[s] += 1
-                    wave.append(i)
-                else:
-                    rest.append(i)
-            # pack the whole wave once, place into the [n*B] block
-            # layout with one fancy index per field (vectorized; the
-            # per-shard pack-and-slice loop was the host bottleneck)
-            packed, errs = pack_requests([reqs[i] for i in wave], now_ms,
-                                         size=len(wave),
-                                         key_hashes=khash[wave])
-            glob = empty_batch(self.n * self.B)
-            positions = np.asarray(wave_pos, np.int64)
-            for f in range(len(glob)):
-                np.asarray(glob[f])[positions] = packed[f][:len(wave)]
-            slot_of = list(zip(wave, wave_pos))
-            errs_all = {i: errs[j] for j, i in enumerate(wave) if errs[j]}
-            status, rem, rst, lim, err = self._run_wave(glob, now_ms)
-            swept = False
-            for i, slot in slot_of:
-                if i in errs_all:
-                    responses[i] = RateLimitResponse(error=errs_all[i])
-                elif err[slot]:
-                    # Probe window exhausted — usually dead (expired) rows
-                    # clogging the chains.  Sweep once and retry the
-                    # request before reporting table-full (the reference's
-                    # LRU never fails an insert; we fail only when the
-                    # table is genuinely full of LIVE keys).
-                    if i not in retried:
-                        retried.add(i)
-                        rest.append(i)
-                        if not swept:
-                            self.sweep(now_ms)
-                            swept = True
-                    elif self._try_auto_grow(grew):
-                        # retry at the doubled capacity; terminates when
-                        # cap reaches auto_grow_limit (growth is strict)
-                        rest.append(i)
-                    else:
-                        responses[i] = RateLimitResponse(
-                            error="rate limit table full")
-                else:
-                    responses[i] = RateLimitResponse(
-                        # attribute lookup, not Status(...): the enum
-                        # constructor costs ~µs and this is per request
-                        status=Status.OVER_LIMIT if status[slot]
-                        else Status.UNDER_LIMIT,
-                        limit=int(lim[slot]),
-                        remaining=int(rem[slot]),
-                        reset_time=int(rst[slot]),
-                    )
-            # Restore request-index order: overflow + retried indices were
-            # appended out of order, and same-key requests must be applied
-            # in original order for sequential parity.
-            pending = sorted(rest)
-        return responses
+        batch, errs = pack_requests(reqs, now_ms, size=len(reqs),
+                                    key_hashes=khash)
+        cols = self.check_packed(batch, khash, now_ms)
+        return responses_from_columns(cols, errs)
 
     def check_packed(self, batch: RequestBatch, khash: np.ndarray,
                      now_ms: int) -> tuple:

@@ -157,7 +157,10 @@ def test_dispatcher_merges_packed_jobs_across_nows():
     release = threading.Event()
     orig = eng.check_packed
 
+    entered = threading.Event()
+
     def gated(batch, kh, now):
+        entered.set()
         release.wait(timeout=30)
         launches.append(len(kh))
         return orig(batch, kh, now)
@@ -186,7 +189,12 @@ def test_dispatcher_merges_packed_jobs_across_nows():
         th = threading.Thread(target=call)
         th.start()
         threads.append(th)
-        time.sleep(0.3)  # let job 0 enter the engine before 1–2 queue
+        if t == 0:
+            assert entered.wait(timeout=30)
+    deadline = time.monotonic() + 30
+    while disp._queue.qsize() < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert disp._queue.qsize() >= 2
     release.set()
     for th in threads:
         th.join(timeout=60)
@@ -213,15 +221,18 @@ def test_mixed_wave_cross_now_merges_list_and_packed_jobs():
                         batch_per_shard=64)
     launches = []
     release = threading.Event()
+    entered = threading.Event()  # the blocker reached the engine
     orig_cp = eng.check_packed
     orig_cb = eng.check_batch
 
     def gated_cp(batch, kh, now):
+        entered.set()
         release.wait(timeout=30)
         launches.append(("packed", len(kh)))
         return orig_cp(batch, kh, now)
 
     def gated_cb(reqs_, now):
+        entered.set()
         release.wait(timeout=30)
         launches.append(("list", len(reqs_)))
         return orig_cb(reqs_, now)
@@ -250,9 +261,7 @@ def test_mixed_wave_cross_now_merges_list_and_packed_jobs():
         target=lambda: results.setdefault(
             "blocker", disp.check_batch(reqs(0), NOW)))]
     threads[0].start()
-    import time as _t
-
-    _t.sleep(0.4)
+    assert entered.wait(timeout=30)  # dispatcher is held in the engine
     threads.append(threading.Thread(
         target=lambda: results.setdefault(
             "list1", disp.check_batch(reqs(1), NOW + 1))))
@@ -265,14 +274,22 @@ def test_mixed_wave_cross_now_merges_list_and_packed_jobs():
             "packed", disp.check_packed(b, kh, NOW + 3))))
     for t in threads[1:]:
         t.start()
-    _t.sleep(0.4)
+    # deterministic: all three jobs must be IN the queue before release
+    import time as _t
+
+    deadline = _t.monotonic() + 30
+    while disp._queue.qsize() < 3 and _t.monotonic() < deadline:
+        _t.sleep(0.01)
+    assert disp._queue.qsize() >= 3
     release.set()
     for t in threads:
         t.join(timeout=60)
     # blocker launched alone (it held the dispatcher while the rest
-    # queued); the remaining three instants merged into ONE launch
-    assert launches[0] == ("list", 6)
-    assert launches[1:] == [("packed", 18)], launches
+    # queued; engine.check_batch delegates to check_packed internally,
+    # so its one launch trips both gates); the remaining three instants
+    # merged into ONE launch
+    assert launches[:2] == [("list", 6), ("packed", 6)]
+    assert launches[2:] == [("packed", 18)], launches
     # exact parity with sequential per-time application
     oracle = Oracle()
     want = {t: oracle.check_batch(reqs(0), NOW + t) for t in range(4)}

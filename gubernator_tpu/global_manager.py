@@ -22,7 +22,6 @@ from typing import Dict, List, Tuple
 
 from .config import BehaviorConfig
 from .interval import IntervalLoop
-from .proto import peers_pb2 as peers_pb
 from .types import Behavior, RateLimitRequest
 
 log = logging.getLogger("gubernator_tpu.global")

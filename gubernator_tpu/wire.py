@@ -9,7 +9,6 @@ from __future__ import annotations
 from typing import List
 
 from .proto import gubernator_pb2 as pb
-from .proto import peers_pb2 as peers_pb
 from .types import (
     Algorithm,
     Behavior,

@@ -189,3 +189,26 @@ def test_sequential_state_carries_across_wire_calls():
         assert statuses == [0, 0, 0, 1, 1]
     finally:
         inst.close()
+
+
+def test_wire_lane_auto_grows_under_live_pressure():
+    """The wire lane inherits auto-grow: a tiny table fills with live
+    keys and capacity doubles instead of surfacing 'table full'."""
+    inst = V1Instance(
+        Config(cache_size=1 << 8, cache_autogrow_max=1 << 14,
+               sweep_interval_ms=0),
+        mesh=make_mesh(n=2))
+    try:
+        reqs = [RateLimitRequest(name="wag", unique_key=f"k{i}", hits=1,
+                                 limit=9, duration=10**7)
+                for i in range(900)]
+        out = pb.GetRateLimitsResp.FromString(
+            inst.get_rate_limits_wire(to_wire(reqs), now_ms=NOW))
+        assert all(r.error == "" for r in out.responses)
+        assert inst.engine.cap_local * inst.engine.n >= 1024
+        # every key re-findable at its consumed value
+        out = pb.GetRateLimitsResp.FromString(
+            inst.get_rate_limits_wire(to_wire(reqs), now_ms=NOW + 1))
+        assert {r.remaining for r in out.responses} == {7}
+    finally:
+        inst.close()

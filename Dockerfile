@@ -4,11 +4,20 @@
 FROM python:3.12-slim
 
 RUN pip install --no-cache-dir "jax[cpu]" numpy grpcio protobuf \
-    prometheus-client cryptography
+    prometheus-client cryptography setuptools
 
 WORKDIR /app
 COPY gubernator_tpu/ gubernator_tpu/
 COPY example.conf /etc/gubernator/gubernator.conf
+
+# C++ fast lane (batch hashing + protobuf wire codec); the service
+# falls back to the pure-Python paths if the build is unavailable
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && python gubernator_tpu/ops/setup_native.py build_ext --inplace \
+    && apt-get purge -y g++ && apt-get autoremove -y \
+    && rm -rf /var/lib/apt/lists/* \
+    || echo "native build unavailable; using pure-Python fallback"
 
 ENV GUBER_GRPC_ADDRESS=0.0.0.0:1051 \
     GUBER_HTTP_ADDRESS=0.0.0.0:1050

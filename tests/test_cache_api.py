@@ -1,6 +1,5 @@
 """Cache-interface parity: each/remove over the device table
 (reference: cache.go › Cache{Each, Remove} — SURVEY.md §2.1)."""
-import numpy as np
 
 from gubernator_tpu.config import Config
 from gubernator_tpu.hashing import hash_keys

@@ -1,14 +1,13 @@
 """Elasticity: membership churn, failure handling, re-sharding
 (reference: SetPeers → picker rebuild + PeerClient drain; SURVEY.md
 §5.3 — keys silently re-home, moved state resets; §7.3 re-sharding)."""
-import numpy as np
 import pytest
 
 from gubernator_tpu import cluster as cluster_mod
 from gubernator_tpu.client import Client
 from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.parallel import ShardedEngine, make_mesh
-from gubernator_tpu.types import RateLimitRequest, Status
+from gubernator_tpu.types import RateLimitRequest
 
 
 def req(name, key, **kw):

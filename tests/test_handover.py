@@ -11,7 +11,7 @@ from gubernator_tpu.config import DaemonConfig
 from gubernator_tpu.daemon import spawn_daemon
 from gubernator_tpu.netutil import free_port
 from gubernator_tpu.parallel import make_mesh
-from gubernator_tpu.types import RateLimitRequest, Status
+from gubernator_tpu.types import RateLimitRequest
 
 N_KEYS = 40
 

@@ -1,7 +1,7 @@
 """An out-of-range wire algorithm must degrade to TOKEN_BUCKET and
 still enforce the limit — an unclamped value would re-create the bucket
 fresh on every request (limit bypass)."""
-from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.parallel import ShardedEngine
 from gubernator_tpu.types import RateLimitRequest, Status
 
 NOW = 1_773_000_000_000

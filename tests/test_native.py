@@ -1,5 +1,4 @@
 """Native host-ops extension tests (skipped when not built)."""
-import numpy as np
 import pytest
 
 native = pytest.importorskip("gubernator_tpu.ops.native")

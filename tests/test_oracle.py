@@ -4,7 +4,6 @@ TestLeakyBucket, TestOverTheLimit, TestChangeLimit, TestResetRemaining,
 TestTokenBucketGregorian — reconstructed)."""
 import datetime as dt
 
-import pytest
 
 from gubernator_tpu import (
     Algorithm,

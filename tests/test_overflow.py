@@ -4,7 +4,7 @@ oracle after clamping (oracle.MAX_INPUT)."""
 import numpy as np
 import pytest
 
-from gubernator_tpu import Algorithm, Behavior, Oracle, RateLimitRequest
+from gubernator_tpu import Algorithm, Oracle, RateLimitRequest
 from gubernator_tpu.oracle import MAX_INPUT
 from gubernator_tpu.parallel import ShardedEngine, make_mesh
 

@@ -4,7 +4,7 @@ functional_test.go › TestGlobalRateLimits, SURVEY.md §4)."""
 import numpy as np
 import pytest
 
-from gubernator_tpu import Algorithm, Behavior, Oracle, RateLimitRequest, Status
+from gubernator_tpu import Algorithm, Oracle, RateLimitRequest
 from gubernator_tpu.parallel import ShardedEngine, make_mesh
 
 NOW = 1_760_000_000_000

@@ -5,14 +5,13 @@ functional suite driving real daemons over loopback gRPC."""
 import threading
 
 import numpy as np
-import pytest
 
 from gubernator_tpu.client import Client
 from gubernator_tpu.cluster import start_with
 from gubernator_tpu.config import BehaviorConfig, DaemonConfig
 from gubernator_tpu.netutil import free_port
 from gubernator_tpu.parallel import make_mesh
-from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
 
 NOW = 1_778_000_000_000
 

@@ -12,7 +12,6 @@ from gubernator_tpu.instance import V1Instance, _wire_native
 from gubernator_tpu.parallel import make_mesh
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.types import (
-    Algorithm,
     Behavior,
     GregorianDuration,
     RateLimitRequest,

@@ -2,7 +2,6 @@
 request stream, get_rate_limits_wire (C++ columnar lane when eligible,
 pb2 fallback otherwise) must match the sequential oracle bit-for-bit —
 the same referee the object path answers to in test_property_parity."""
-import numpy as np
 import pytest
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st

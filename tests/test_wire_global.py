@@ -1,6 +1,5 @@
 """Columnar solo-GLOBAL wire lane: the hot-set psum tier driven from
 wire bytes (instance._wire_global_runner), vs the object path."""
-import numpy as np
 import pytest
 
 from gubernator_tpu.config import BehaviorConfig, Config
@@ -8,7 +7,7 @@ from gubernator_tpu.hashing import hash_key
 from gubernator_tpu.instance import V1Instance, _wire_native
 from gubernator_tpu.parallel import make_mesh
 from gubernator_tpu.proto import gubernator_pb2 as pb
-from gubernator_tpu.types import Behavior, RateLimitRequest, Status
+from gubernator_tpu.types import Behavior, RateLimitRequest
 from gubernator_tpu.wire import req_to_pb
 
 if _wire_native is None:  # pragma: no cover

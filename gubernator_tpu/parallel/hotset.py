@@ -394,9 +394,14 @@ class HotSetEngine:
         lim = np.zeros(n_req, np.int64)
         lost = np.zeros(n_req, bool)
         W = self.n * self.B
+        # earliest requests take the earliest waves (same rule as
+        # check_packed): merged batches spanning instants keep per-key
+        # time monotone across internal waves too
+        by_time = np.argsort(np.asarray(batch.now), kind="stable")
         done = 0
         while done < n_req:
             m = min(W, n_req - done)
+            idx = by_time[done:done + m]  # original indices, time order
             p = np.arange(m)
             chip = (self._rr + p) % self.n
             self._rr += m
@@ -409,15 +414,14 @@ class HotSetEngine:
             positions = chip * self.B + rowin
             glob = empty_batch(W)
             for f in range(len(glob)):
-                np.asarray(glob[f])[positions] = \
-                    np.asarray(batch[f])[done:done + m]
+                np.asarray(glob[f])[positions] = np.asarray(batch[f])[idx]
             o_st, o_rem, o_rst, o_lim, o_err = self._run_hot_wave(
                 glob, now_ms)
-            status[done:done + m] = o_st[positions]
-            rem[done:done + m] = o_rem[positions]
-            rst[done:done + m] = o_rst[positions]
-            lim[done:done + m] = o_lim[positions]
-            lost[done:done + m] = o_err[positions]
+            status[idx] = o_st[positions]
+            rem[idx] = o_rem[positions]
+            rst[idx] = o_rst[positions]
+            lim[idx] = o_lim[positions]
+            lost[idx] = o_err[positions]
             done += m
         return status, rem, rst, lim, lost
 

@@ -19,13 +19,40 @@ import numpy as np
 
 from ..gregorian import gregorian_expiration, gregorian_rate_duration_ms
 from ..hashing import hash_keys
-from ..types import Behavior, RateLimitRequest
+from ..types import (DURATION_MAX, EFF_MAX, TD_BOUND, VALUE_MAX, Behavior,
+                     RateLimitRequest)
 
 #: Batch sizes are rounded up to one of these to bound compile cache size.
 BATCH_BUCKETS = (64, 256, 1024, 4096)
 
-#: oracle.MAX_INPUT: keeps td products in int64
-MAX_INPUT = (1 << 31) - 1
+#: Back-compat alias for the old global input ceiling; the real bounds
+#: are algorithm-aware now (types.py: DURATION_MAX / VALUE_MAX / EFF_MAX
+#: / TD_BOUND — see oracle.py "Input clamps").
+MAX_INPUT = VALUE_MAX
+
+
+def clamp_config(algorithm, limit, duration, burst, behavior=0):
+    """Scalar mirror of the packer clamps for (alg, limit, duration, burst).
+
+    Used by the hot-set pin path (parallel/hotset.py) so pinned rows agree
+    bit-for-bit with every packed request carrying the same config — a
+    disagreement reads as a config change on the device and resets the
+    row.  Must stay in lockstep with pack_requests/pack_columns and the
+    oracle's _clamp_token/_clamp_leaky.
+    """
+    alg = 1 if int(algorithm) == 1 else 0
+    duration = min(int(duration), DURATION_MAX)
+    if alg == 1:
+        if int(behavior) & int(Behavior.DURATION_IS_GREGORIAN):
+            eff = gregorian_rate_duration_ms(duration)
+        else:
+            eff = max(duration, 1)
+        cap_v = min(TD_BOUND // min(eff, EFF_MAX), VALUE_MAX)
+    else:
+        cap_v = VALUE_MAX
+    limit = min(max(int(limit), 0), cap_v)
+    burst = min(int(burst), cap_v) if int(burst) > 0 else limit
+    return alg, limit, duration, burst
 
 
 class RequestBatch(NamedTuple):
@@ -96,7 +123,6 @@ def pack_requests(
     """
     n = len(reqs)
     b = empty_batch(size if size is not None else bucket_size(n))
-    MAXI = MAX_INPUT
     errors = [""] * n
     b.key[:n] = key_hashes if key_hashes is not None else hash_keys(
         [r.key for r in reqs])
@@ -104,19 +130,28 @@ def pack_requests(
     b.now[:n] = now_ms
     for i, r in enumerate(reqs):
         behavior = int(r.behavior)
-        duration = min(int(r.duration), MAXI)
-        limit = min(max(int(r.limit), 0), MAXI)
+        leaky = int(r.algorithm) == 1
+        duration = min(int(r.duration), DURATION_MAX)
         if behavior & GREG:
             try:
                 b.greg_end[i] = gregorian_expiration(now_ms, duration)
-                b.eff_ms[i] = gregorian_rate_duration_ms(duration)
+                eff = gregorian_rate_duration_ms(duration)
             except (ValueError, KeyError):
                 errors[i] = f"invalid gregorian duration ordinal: {duration}"
                 b.key[i] = 0
                 continue
         else:
-            b.eff_ms[i] = max(duration, 1)
-        b.hits[i] = min(max(int(r.hits), 0), MAXI)
+            eff = max(duration, 1)
+        # leaky td bounds: eff ≤ EFF_MAX, values ≤ TD_BOUND // eff
+        # (oracle.py › _clamp_leaky); token values ≤ VALUE_MAX
+        if leaky:
+            eff = min(eff, EFF_MAX)
+            cap_v = min(TD_BOUND // eff, VALUE_MAX)
+        else:
+            cap_v = VALUE_MAX
+        limit = min(max(int(r.limit), 0), cap_v)
+        b.eff_ms[i] = eff
+        b.hits[i] = min(max(int(r.hits), 0), cap_v)
         b.limit[i] = limit
         b.duration[i] = duration
         b.behavior[i] = behavior
@@ -124,8 +159,8 @@ def pack_requests(
         # (like the oracle's `== LEAKY_BUCKET` test) — an unclamped
         # value would never equal the stored alg&1 and the row would
         # re-create fresh on every request, bypassing the limit
-        b.algorithm[i] = 1 if int(r.algorithm) == 1 else 0
-        b.burst[i] = min(int(r.burst), MAXI) if int(r.burst) > 0 else limit
+        b.algorithm[i] = 1 if leaky else 0
+        b.burst[i] = min(int(r.burst), cap_v) if int(r.burst) > 0 else limit
         b.valid[i] = True
     return b, errors
 
@@ -149,36 +184,46 @@ def pack_columns(
     pb2 path).  ``khash`` must already be mixed and zero-remapped.
     """
     n = len(khash)
-    MAXI = MAX_INPUT
-    lim = np.clip(limit, 0, MAXI)
-    dur = np.minimum(duration, MAXI)
-    b = RequestBatch(
-        key=khash.astype(np.uint64).copy(),
-        hits=np.clip(hits, 0, MAXI),
-        limit=lim,
-        duration=dur.copy(),
-        eff_ms=np.maximum(dur, 1),
-        greg_end=np.zeros(n, np.int64),
-        behavior=behavior.astype(np.int32),
-        algorithm=(algorithm == 1).astype(np.int32),
-        burst=np.where(burst > 0, np.minimum(burst, MAXI), lim),
-        valid=np.ones(n, bool),
-        now=np.full(n, now_ms, np.int64),
-    )
+    behavior32 = behavior.astype(np.int32)
+    dur = np.minimum(np.asarray(duration, np.int64), DURATION_MAX)
+    eff = np.maximum(dur, 1)
+    greg_end = np.zeros(n, np.int64)
+    valid = np.ones(n, bool)
+    key_col = khash.astype(np.uint64).copy()
     errors: dict = {}
-    greg = (b.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    greg = (behavior32 & int(Behavior.DURATION_IS_GREGORIAN)) != 0
     if greg.any():
         # ≤ a handful of distinct calendar ordinals per batch: compute
         # each period end once on the host, broadcast to its requests
         for d in np.unique(dur[greg]):
             m = greg & (dur == d)
             try:
-                b.greg_end[m] = gregorian_expiration(now_ms, int(d))
-                b.eff_ms[m] = gregorian_rate_duration_ms(int(d))
+                greg_end[m] = gregorian_expiration(now_ms, int(d))
+                eff[m] = gregorian_rate_duration_ms(int(d))
             except (ValueError, KeyError):
-                b.valid[m] = False
-                b.key[m] = 0
+                valid[m] = False
+                key_col[m] = 0
                 msg = f"invalid gregorian duration ordinal: {int(d)}"
                 for i in np.nonzero(m)[0]:
                     errors[int(i)] = msg
+    # leaky td bounds (oracle.py › _clamp_leaky): eff ≤ EFF_MAX and
+    # hits/limit/burst ≤ TD_BOUND // eff; token values ≤ VALUE_MAX
+    leaky = np.asarray(algorithm) == 1
+    eff = np.where(leaky, np.minimum(eff, EFF_MAX), eff)
+    cap_v = np.where(leaky, np.minimum(TD_BOUND // eff, VALUE_MAX),
+                     VALUE_MAX)
+    lim = np.minimum(np.clip(np.asarray(limit, np.int64), 0, None), cap_v)
+    b = RequestBatch(
+        key=key_col,
+        hits=np.minimum(np.clip(np.asarray(hits, np.int64), 0, None), cap_v),
+        limit=lim,
+        duration=dur.copy(),
+        eff_ms=eff,
+        greg_end=greg_end,
+        behavior=behavior32,
+        algorithm=leaky.astype(np.int32),
+        burst=np.where(burst > 0, np.minimum(burst, cap_v), lim),
+        valid=valid,
+        now=np.full(n, now_ms, np.int64),
+    )
     return b, errors

@@ -48,7 +48,9 @@ units, int64) and replenishes exactly: ``remaining_td += elapsed × limit``
 ``hits × duration_eff`` td.  Observable integer behavior (allow/deny,
 ``remaining`` floor, reset_time) matches the reference's within one
 sub-millisecond-token rounding; allow/deny parity on integer-rate
-workloads is exact.  Domain: ``limit × duration_eff < 2^63``.
+workloads is exact.  Domain: every td product is kept ≤ TD_BOUND (2^61)
+by the input clamps below plus two in-kernel guards (rescale/replenish —
+see the comment block above ``_clamp_token``).
 
 - Gregorian ordinals use the calendar for token expiry; the leak rate for
   leaky uses the fixed-width approximation (GREGORIAN_APPROX_MS).
@@ -60,7 +62,12 @@ workloads is exact.  Domain: ``limit × duration_eff < 2^63``.
   expire_at = now + duration_eff (sliding TTL).
 
 Input clamps (applied to every request): hits < 0 → 0, limit < 0 → 0,
-non-Gregorian duration < 1 → 1, burst ≤ 0 → limit.
+non-Gregorian duration < 1 → 1, burst ≤ 0 → limit.  int64-safety bounds
+(types.py): duration ≤ DURATION_MAX (2^53 ms); token hits/limit ≤
+VALUE_MAX (2^53); leaky eff ≤ EFF_MAX (2^35, ~1.09y — calendar windows
+beyond that are DURATION_IS_GREGORIAN's job) and leaky hits/limit/burst
+≤ TD_BOUND // eff.  A 30-day (or multi-year) millisecond duration passes
+through un-truncated on both algorithms.
 """
 from __future__ import annotations
 
@@ -69,6 +76,11 @@ from typing import Dict, List, Optional, Tuple
 
 from .gregorian import gregorian_expiration, gregorian_rate_duration_ms
 from .types import (
+    DURATION_MAX,
+    EFF_MAX,
+    FRAC_SAFE,
+    TD_BOUND,
+    VALUE_MAX,
     Algorithm,
     Behavior,
     RateLimitRequest,
@@ -116,26 +128,45 @@ def _token_expire(now_ms: int, created_ms: int, duration: int, behavior: int) ->
     return created_ms + max(int(duration), 1)
 
 
-#: Input ceiling for hits/limit/burst and ms durations: keeps every td
-#: fixed-point product (value × duration_eff) inside int64 —
-#: 2^31 × 2^31 < 2^63.  Clamped identically by the device batch packer
-#: (core/batch.py) so parity holds on adversarial inputs.  The duration
-#: ceiling is ~24.8 days; calendar-scale windows are what
-#: DURATION_IS_GREGORIAN exists for.
-MAX_INPUT = (1 << 31) - 1
+# Input clamps (the int64-safety contract; bounds live in types.py and
+# are applied identically by the device packers, core/batch.py):
+#
+# - duration (ms) ≤ DURATION_MAX (2^53, ~285k years) — a 30-day or
+#   multi-year window passes through un-truncated.
+# - TOKEN_BUCKET hits/limit ≤ VALUE_MAX (2^53).
+# - LEAKY_BUCKET: eff ≤ EFF_MAX (2^35, ~1.09y), then hits/limit/burst
+#   ≤ TD_BOUND // eff so every td product stays ≤ 2^61.
+#
+# Two in-kernel guards complete the contract (mirrored bit-for-bit in
+# core/step.py › _apply_position):
+# - rescale-on-duration-change clamps whole tokens to TD_BOUND // new_eff
+#   and keeps the sub-token fraction only when both denominators are
+#   ≤ FRAC_SAFE (else floors to whole tokens — a < 1-token deviation);
+# - replenish treats elapsed > TD_BOUND // limit as "bucket refilled to
+#   burst" (exact: the true product already exceeds the burst cap).
 
 
-def _clamp_req(req: RateLimitRequest) -> Tuple[int, int, int, int]:
-    hits = min(max(int(req.hits), 0), MAX_INPUT)
-    limit = min(max(int(req.limit), 0), MAX_INPUT)
-    duration = min(int(req.duration), MAX_INPUT)
+def _clamp_token(req: RateLimitRequest) -> Tuple[int, int, int]:
+    hits = min(max(int(req.hits), 0), VALUE_MAX)
+    limit = min(max(int(req.limit), 0), VALUE_MAX)
+    duration = min(int(req.duration), DURATION_MAX)
+    return hits, limit, duration
+
+
+def _clamp_leaky(req: RateLimitRequest) -> Tuple[int, int, int, int, int]:
+    """(hits, limit, duration, burst, eff) under the leaky td bounds."""
+    duration = min(int(req.duration), DURATION_MAX)
+    eff = min(_eff_duration_ms(duration, int(req.behavior)), EFF_MAX)
+    cap_v = min(TD_BOUND // eff, VALUE_MAX)
+    hits = min(max(int(req.hits), 0), cap_v)
+    limit = min(max(int(req.limit), 0), cap_v)
     burst = int(req.burst) if int(req.burst) > 0 else limit
-    burst = min(burst, MAX_INPUT)
-    return hits, limit, duration, burst
+    burst = min(burst, cap_v)
+    return hits, limit, duration, burst, eff
 
 
 def _new_token_item(req: RateLimitRequest, now_ms: int) -> Item:
-    hits, limit, duration, _ = _clamp_req(req)
+    hits, limit, duration = _clamp_token(req)
     return Item(
         algorithm=Algorithm.TOKEN_BUCKET,
         limit=limit,
@@ -150,8 +181,7 @@ def _new_token_item(req: RateLimitRequest, now_ms: int) -> Item:
 
 
 def _new_leaky_item(req: RateLimitRequest, now_ms: int) -> Item:
-    hits, limit, duration, burst = _clamp_req(req)
-    eff = _eff_duration_ms(duration, req.behavior)
+    hits, limit, duration, burst, eff = _clamp_leaky(req)
     return Item(
         algorithm=Algorithm.LEAKY_BUCKET,
         limit=limit,
@@ -167,7 +197,7 @@ def _new_leaky_item(req: RateLimitRequest, now_ms: int) -> Item:
 
 def apply_token(item: Optional[Item], req: RateLimitRequest, now_ms: int
                 ) -> Tuple[Item, RateLimitResponse]:
-    hits, r_limit, r_duration, _ = _clamp_req(req)
+    hits, r_limit, r_duration = _clamp_token(req)
     behavior = int(req.behavior)
 
     if item is None or now_ms >= item.expire_at or item.algorithm != Algorithm.TOKEN_BUCKET:
@@ -210,9 +240,8 @@ def apply_token(item: Optional[Item], req: RateLimitRequest, now_ms: int
 
 def apply_leaky(item: Optional[Item], req: RateLimitRequest, now_ms: int
                 ) -> Tuple[Item, RateLimitResponse]:
-    hits, r_limit, r_duration, r_burst = _clamp_req(req)
+    hits, r_limit, r_duration, r_burst, eff = _clamp_leaky(req)
     behavior = int(req.behavior)
-    eff = _eff_duration_ms(r_duration, behavior)
 
     if item is None or now_ms >= item.expire_at or item.algorithm != Algorithm.LEAKY_BUCKET:
         item = _new_leaky_item(req, now_ms)
@@ -220,9 +249,15 @@ def apply_leaky(item: Optional[Item], req: RateLimitRequest, now_ms: int
         if eff != item.eff_ms:
             # Duration (or its Gregorian interpretation) changed → rescale
             # td to the new denominator, using the denominator the item was
-            # actually stored with.
+            # actually stored with.  Whole tokens clamp to the new bound
+            # (they could not survive the burst cap anyway); the sub-token
+            # fraction is kept only while frac × eff fits int64.
             whole, frac = divmod(item.remaining, item.eff_ms)
-            item.remaining = whole * eff + (frac * eff) // item.eff_ms
+            whole = min(whole, TD_BOUND // eff)
+            if item.eff_ms <= FRAC_SAFE and eff <= FRAC_SAFE:
+                item.remaining = whole * eff + (frac * eff) // item.eff_ms
+            else:
+                item.remaining = whole * eff
             item.eff_ms = eff
         item.duration = r_duration
         if behavior & Behavior.RESET_REMAINING:
@@ -231,9 +266,15 @@ def apply_leaky(item: Optional[Item], req: RateLimitRequest, now_ms: int
         item.limit = r_limit
         item.burst = r_burst
         # Replenish exactly: elapsed ms × limit td, clamped to burst.
+        # When elapsed × limit would overflow int64 the true product
+        # already exceeds the burst cap (cap ≤ TD_BOUND), so the bucket
+        # is simply full — exact, not an approximation.
         elapsed = now_ms - item.t_ms
         cap = item.burst * eff
-        item.remaining = min(item.remaining + elapsed * item.limit, cap)
+        if elapsed > TD_BOUND // max(item.limit, 1):
+            item.remaining = cap
+        else:
+            item.remaining = min(item.remaining + elapsed * item.limit, cap)
         item.t_ms = now_ms
 
     rate = eff // item.limit if item.limit > 0 else eff

@@ -63,11 +63,11 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..core.batch import (MAX_INPUT as _MAXI, RequestBatch,
+from ..core.batch import (RequestBatch, clamp_config,
                           empty_batch, pack_requests)
 from ..core.step import decide_batch_impl
 from ..core.table import TableState, init_table
-from ..types import RateLimitRequest, RateLimitResponse, Status
+from ..types import EFF_MAX, RateLimitRequest, RateLimitResponse, Status
 from .mesh import SHARD_AXIS
 
 
@@ -79,11 +79,8 @@ def _cfg_of(req: RateLimitRequest) -> tuple:
     """(alg, limit, duration, burst) exactly as pack_requests clamps them
     — the pinned row must agree with every packed request that hits it,
     else the device step would see a config change and reset the row."""
-    alg = 1 if int(req.algorithm) == 1 else 0
-    limit = min(max(int(req.limit), 0), _MAXI)
-    dur = max(min(int(req.duration), _MAXI), 1)
-    burst = min(int(req.burst), _MAXI) if int(req.burst) > 0 else limit
-    return alg, limit, dur, burst
+    return clamp_config(req.algorithm, req.limit, req.duration, req.burst,
+                        req.behavior)
 
 
 def make_hot_step(mesh):
@@ -137,10 +134,13 @@ def make_hot_sync(mesh):
         start = jnp.where(refreshed, limit, brem)
         d_tok = jnp.maximum(start - st.remaining, 0)
         # --- leaky: consumption vs base replenished to the replica's t.
-        # elapsed is clamped so elapsed × limit cannot wrap int64 (inputs
-        # are ≤ 2^31 per pack_requests' MAX_INPUT clamp, so cap_td ≤ 2^62
-        # and the clamped product ≤ cap_td + limit).
-        eff = jnp.maximum(st.eff_ms, 1)
+        # elapsed is clamped so elapsed × limit cannot wrap int64: leaky
+        # burst ≤ TD_BOUND // eff per the packer clamps, so cap_td ≤ 2^61
+        # and the clamped product ≤ cap_td + limit < 2^62.  eff is masked
+        # to 1 on token rows (stored token eff can reach DURATION_MAX =
+        # 2^53; an unmasked product would wrap even though d_leaky is
+        # discarded by the is_leaky select).
+        eff = jnp.maximum(jnp.where(is_leaky, st.eff_ms, 1), 1)
         cap_td = st.burst * eff
         el_max = cap_td // jnp.maximum(limit, 1) + 1
 
@@ -258,15 +258,25 @@ class HotSetEngine:
             self.slots[key_hash] = slot
             self.pinned_cfg[key_hash] = _cfg_of(req)
         alg, limit, dur, burst = _cfg_of(req)
+        # Effective denominator exactly as the packers compute it
+        # (core/batch.py): floor at 1; leaky additionally clamps to
+        # EFF_MAX (the td-bound contract).  Gregorian is _HOT_EXCLUDED,
+        # so the non-calendar branch is the only one.  Seeding eff from
+        # the raw duration would disagree with every packed request
+        # (spurious per-step "eff change") and burst × dur could wrap
+        # int64 at calendar-scale durations.
+        eff = max(int(dur), 1)
+        if alg:
+            eff = min(eff, EFF_MAX)
         # fresh leaky buckets start at burst × eff token-duration fixed
         # point; token buckets at limit (core/step.py › rem_fresh)
-        rem0 = burst * dur if alg else limit
+        rem0 = burst * eff if alg else limit
         host = {
             "key": np.uint64(key_hash), "meta": np.int32(alg),
             "limit": np.int64(limit), "duration": np.int64(dur),
-            "eff_ms": np.int64(dur), "burst": np.int64(burst),
+            "eff_ms": np.int64(eff), "burst": np.int64(burst),
             "remaining": np.int64(rem0), "t_ms": np.int64(now_ms),
-            "expire_at": np.int64(now_ms + dur),
+            "expire_at": np.int64(now_ms + eff),
         }
         if seed is not None:
             for f in ("remaining", "t_ms", "expire_at", "meta"):

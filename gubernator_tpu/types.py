@@ -62,6 +62,36 @@ class GregorianDuration(enum.IntEnum):
 #: reference: gubernator.go › maxBatchSize
 MAX_BATCH_SIZE = 1000
 
+# --- int64-safety input bounds (the "Input clamps" contract in oracle.py;
+# reference algorithms.go takes int64 durations — these bounds keep every
+# intermediate product inside int64 while admitting calendar-scale ms
+# durations.  Applied identically by the oracle and the device packers
+# (core/batch.py); parity tests enforce agreement.)
+
+#: Millisecond durations clamp (~285k years); token-bucket expiry adds
+#: this to epoch ms (< 2^41), so sums stay far below 2^63.
+DURATION_MAX = 1 << 53
+
+#: hits/limit/burst ceiling for TOKEN_BUCKET (sums/diffs stay < 2^54).
+VALUE_MAX = 1 << 53
+
+#: LEAKY_BUCKET effective-duration denominator ceiling (~1.09 years of
+#: ms).  Calendar-scale leaky windows beyond this are what
+#: DURATION_IS_GREGORIAN exists for (its rate denominators are all
+#: < 2^35 too).
+EFF_MAX = 1 << 35
+
+#: Leaky token-duration fixed-point bound: per-request, hits/limit/burst
+#: are clamped to TD_BOUND // eff so every td product (value × eff,
+#: elapsed × limit) stays ≤ 2^61 and any sum of two stays < 2^63.
+TD_BOUND = 1 << 61
+
+#: Rescale-on-duration-change keeps the sub-token fractional part only
+#: when both denominators are below this (frac × eff must fit int64);
+#: above it the rescale floors to whole tokens — a < 1-token, defined
+#: deviation applied identically by oracle and device.
+FRAC_SAFE = 1 << 31
+
 #: Millisecond durations for the fixed-width Gregorian periods (used for
 #: leak-rate math; actual expiry is computed on the calendar).
 GREGORIAN_APPROX_MS = {

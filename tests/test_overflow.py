@@ -1,11 +1,11 @@
 """Adversarial-input parity: huge hits/limit/burst/duration must not
 overflow int64 fixed-point products, and the device must agree with the
-oracle after clamping (oracle.MAX_INPUT)."""
+oracle after clamping (oracle.py "Input clamps"; bounds in types.py)."""
 import numpy as np
 import pytest
 
 from gubernator_tpu import Algorithm, Oracle, RateLimitRequest
-from gubernator_tpu.oracle import MAX_INPUT
+from gubernator_tpu.types import EFF_MAX, TD_BOUND
 from gubernator_tpu.parallel import ShardedEngine, make_mesh
 
 NOW = 1_772_000_000_000
@@ -49,8 +49,11 @@ def test_clamped_values_stay_in_int64(engine):
                          algorithm=Algorithm.LEAKY_BUCKET, burst=2**63 - 1)
     got = engine.check_batch([r], NOW)[0]
     assert got.error == ""
-    assert 0 <= got.remaining <= MAX_INPUT
-    assert got.limit == MAX_INPUT
+    # duration clamps to DURATION_MAX, eff to EFF_MAX, and the leaky
+    # value ceiling is TD_BOUND // eff
+    cap_v = TD_BOUND // EFF_MAX
+    assert 0 <= got.remaining <= cap_v
+    assert got.limit == cap_v
 
 
 def test_negative_inputs_clamp_to_zero(engine):

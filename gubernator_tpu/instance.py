@@ -360,28 +360,41 @@ class V1Instance:
         Takes the C++ columnar fast lane (ops/_native.cpp: wire bytes →
         packed arrays → one device step → wire bytes, zero per-request
         Python objects) when the batch qualifies: extension built, no
-        peers, no Store hooks, no MULTI_REGION behaviors, no metadata,
-        non-empty names/keys.  Solo GLOBAL batches ride a columnar
-        hot-set flow (pinned keys → replica step, the rest → sharded
-        step + vectorized promotion counting); anything the lanes can't
-        model falls back to the pb2 object path with identical
-        semantics.  Raises ValueError on oversize batches (mirroring
-        ``get_rate_limits``).
+        Store hooks, no MULTI_REGION behaviors, no metadata, non-empty
+        names/keys.  Solo (no peers beyond self): GLOBAL batches ride a
+        columnar hot-set flow (pinned keys → replica step, the rest →
+        sharded step + vectorized promotion counting).  Clustered:
+        non-GLOBAL batches ride the clustered columnar lane — ring-split
+        by owner, owned keys stepped locally, the rest forwarded as raw
+        TLV slices over the peer wire and spliced back in order
+        (_wire_check_clustered).  Anything the lanes can't model falls
+        back to the pb2 object path with identical semantics.  Raises
+        ValueError on oversize batches (mirroring ``get_rate_limits``).
         """
         parsed = None
         is_global = False
-        if (_wire_native is not None and self.store is None
-                and not self.peers()):
+        clustered = False
+        if _wire_native is not None and self.store is None:
             parsed = _wire_native.parse_get_rate_limits(data)
             if parsed is not None:
                 if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
                     parsed = None
                 else:
+                    is_global = bool(parsed["behavior_or"]
+                                     & int(Behavior.GLOBAL))
+                    peer_list = self.peers()
+                    solo = not peer_list or all(
+                        self.is_self(p) for p in peer_list)
+                    if not solo:
+                        if is_global:
+                            # clustered GLOBAL queues per-request async
+                            # reconciliation — object path
+                            parsed = None
+                        else:
+                            clustered = True
                     # solo GLOBAL rides the columnar hot-set flow; the
                     # object path's queue_update is a no-op with no
                     # peers (nothing to broadcast to)
-                    is_global = bool(parsed["behavior_or"]
-                                     & int(Behavior.GLOBAL))
         if parsed is not None:
             n = parsed["n"]
             if n > MAX_BATCH_SIZE:
@@ -391,12 +404,21 @@ class V1Instance:
             now = clock_ms() if now_ms is None else now_ms
             # all gating happens before metrics or state are touched:
             # a None runner falls through to the object path untouched
-            runner = (self._wire_global_runner(parsed, now) if is_global
-                      else (lambda: self._wire_check_columns(parsed,
-                                                             now)))
+            if clustered:
+                lane = "wire_clustered"
+                runner = lambda: self._wire_check_clustered(  # noqa: E731
+                    parsed, data, now)
+            elif is_global:
+                lane = "wire_hotset"
+                runner = self._wire_global_runner(parsed, now)
+            else:
+                lane = "wire_local"
+                runner = lambda: self._wire_check_columns(  # noqa: E731
+                    parsed, now)
             if runner is not None:
                 self.metrics.getratelimit_counter.labels(
                     calltype="api").inc(n)
+                self.metrics.wire_lane_counter.labels(lane=lane).inc(n)
                 self.metrics.concurrent_checks.inc()
                 try:
                     with self.metrics.time_func("GetRateLimits"):
@@ -418,6 +440,8 @@ class V1Instance:
             # the raw-bytes handler existed
             raise ValueError(f"invalid GetRateLimitsReq: {e}") from e
         reqs = [req_from_pb(m) for m in msg.requests]
+        self.metrics.wire_lane_counter.labels(
+            lane="pb2_fallback").inc(len(reqs))
         resps = self.get_rate_limits(reqs, now_ms=now_ms)
         out = pb.GetRateLimitsResp()
         out.responses.extend(resp_to_pb(r) for r in resps)
@@ -448,6 +472,8 @@ class V1Instance:
                 raise ValueError(
                     f"invalid GetPeerRateLimitsReq: {e}") from e
             reqs = [req_from_pb(m) for m in msg.requests]
+            self.metrics.wire_lane_counter.labels(
+                lane="peer_pb2_fallback").inc(len(reqs))
             resps = self.get_peer_rate_limits(reqs, now_ms=now_ms)
             out = peers_pb.GetPeerRateLimitsResp()
             out.rate_limits.extend(resp_to_pb(r) for r in resps)
@@ -458,6 +484,8 @@ class V1Instance:
                 f"{self.config.behaviors.batch_limit}")
         now = clock_ms() if now_ms is None else now_ms
         self.metrics.getratelimit_counter.labels(calltype="peer").inc(
+            parsed["n"])
+        self.metrics.wire_lane_counter.labels(lane="peer_wire").inc(
             parsed["n"])
         return self._wire_check_columns(parsed, now)
 
@@ -499,9 +527,11 @@ class V1Instance:
                 if (pinned_mask & excluded).any():
                     return None  # flagged request on a pinned key
                 # config match, vectorized over the few unique hot keys
+                # (duration compares unfloored, exactly as clamp_config
+                # and pack_columns store it)
                 alg = np.asarray(batch.algorithm)
                 lim = np.asarray(batch.limit)
-                dur = np.maximum(np.asarray(batch.duration), 1)
+                dur = np.asarray(batch.duration)
                 bur = np.asarray(batch.burst)
                 for k in np.unique(kh[pinned_mask]):
                     cfg = hs.pinned_cfg.get(int(k))
@@ -581,18 +611,16 @@ class V1Instance:
 
         return run
 
-    def _wire_check_columns(self, parsed: dict, now: int) -> bytes:
-        """Shared fast-lane body: parsed columns → device step →
-        serialized responses (identical for the client and peer wire)."""
+    def _packed_check_to_bytes(self, kh: np.ndarray, hits, limit, duration,
+                               algorithm, behavior, burst, now: int
+                               ) -> bytes:
+        """Columns → pack → device step → response wire bytes: the
+        shared fast-lane body (solo client wire, peer wire, and the
+        clustered lane's local sub-batch all end here)."""
         from .core.batch import pack_columns
-        from .hashing import mix64_np
 
-        n = parsed["n"]
-        kh = mix64_np(parsed["khash_raw"])
-        kh = np.where(kh == 0, np.uint64(1), kh)
-        batch, errs = pack_columns(
-            kh, parsed["hits"], parsed["limit"], parsed["duration"],
-            parsed["algorithm"], parsed["behavior"], parsed["burst"], now)
+        batch, errs = pack_columns(kh, hits, limit, duration, algorithm,
+                                   behavior, burst, now)
         status, lim, rem, rst, full = self.dispatcher.check_packed(
             batch, kh, now)
         self.metrics.over_limit_counter.inc(int((status == 1).sum()))
@@ -600,7 +628,7 @@ class V1Instance:
         if errs or full.any():
             # errored rows already come back zeroed from the device
             # (invalid/overfull rows are masked out)
-            errors = [None] * n
+            errors = [None] * len(kh)
             for i, emsg in errs.items():
                 errors[i] = emsg
             for i in np.nonzero(full)[0]:
@@ -608,6 +636,110 @@ class V1Instance:
                     errors[int(i)] = "rate limit table full"
         return _wire_native.build_rate_limit_resps(
             status, lim, rem, rst, errors)
+
+    def _wire_check_columns(self, parsed: dict, now: int) -> bytes:
+        """Parsed wire columns → device step → serialized responses
+        (identical for the client and peer wire)."""
+        from .hashing import mix64_np
+
+        kh = mix64_np(parsed["khash_raw"])
+        kh = np.where(kh == 0, np.uint64(1), kh)
+        return self._packed_check_to_bytes(
+            kh, parsed["hits"], parsed["limit"], parsed["duration"],
+            parsed["algorithm"], parsed["behavior"], parsed["burst"], now)
+
+    def _wire_check_clustered(self, parsed: dict, data: bytes, now: int
+                              ) -> bytes:
+        """Clustered wire fast lane (the cluster twin of
+        ``_wire_check_columns``): C++ parse → batch hash → vectorized
+        ring split by owner → forward each remote owner's sub-batch as
+        verbatim request-TLV slices over the peer wire (framing is
+        byte-compatible: GetRateLimitsReq.requests and
+        GetPeerRateLimitsReq.requests are both field 1) → device step
+        for owned keys, overlapped with the forward RPCs → splice
+        response TLVs back together in request order.
+
+        Zero per-request Python objects end to end; the owner side rides
+        get_peer_rate_limits_wire's columnar lane.  A failed forward
+        degrades to per-request error responses for that sub-batch only,
+        mirroring the object path's per-request forward errors."""
+        from .hashing import mix64_np
+
+        n = parsed["n"]
+        raw = mix64_np(parsed["khash_raw"])
+        with self._peer_mu:
+            picker = self._picker
+            peer_list = picker.owner_peers()
+            # pre-zero-remap, matching picker.get(key)'s hash pipeline
+            owners = picker.owner_indices(raw)
+        kh = np.where(raw == 0, np.uint64(1), raw)
+        toff, tlen = parsed["tlv_off"], parsed["tlv_len"]
+
+        self_pi = [pi for pi, p in enumerate(peer_list) if self.is_self(p)]
+        local_mask = np.isin(owners, self_pi)
+        item_tlvs: List[Optional[bytes]] = [None] * n
+
+        # fire remote forwards first so the local device step overlaps.
+        # NB: a grpc call future is itself an RpcError subclass, so
+        # dispatch failures travel in their own slot, never by isinstance
+        groups = []
+        for pi in np.unique(owners[~local_mask]):
+            idxs = np.nonzero(owners == pi)[0]
+            sub = b"".join(
+                data[int(toff[i]):int(toff[i] + tlen[i])] for i in idxs)
+            fut = send_err = None
+            try:
+                fut = peer_list[int(pi)].get_peer_rate_limits_raw_future(sub)
+            except Exception as e:  # noqa: BLE001 - incl. ErrClosing
+                send_err = e
+            groups.append((idxs, fut, send_err))
+
+        over = 0  # remote OVER_LIMITs (the local step counts its own)
+        local_idx = np.nonzero(local_mask)[0]
+        if local_idx.size:
+            lbytes = self._packed_check_to_bytes(
+                kh[local_idx], parsed["hits"][local_idx],
+                parsed["limit"][local_idx], parsed["duration"][local_idx],
+                parsed["algorithm"][local_idx],
+                parsed["behavior"][local_idx],
+                parsed["burst"][local_idx], now)
+            lo, ll, _ = _wire_native.split_resp_items(lbytes)
+            for j, i in enumerate(local_idx):
+                item_tlvs[int(i)] = lbytes[int(lo[j]):int(lo[j] + ll[j])]
+
+        for idxs, fut, send_err in groups:
+            rbytes, err, sp = None, send_err, None
+            if fut is not None:
+                try:
+                    rbytes = fut.result()  # deadline set at call time
+                except Exception as e:  # noqa: BLE001
+                    err = e
+            if rbytes is not None:
+                sp = _wire_native.split_resp_items(rbytes)
+                if sp is None or sp[0].size != idxs.size:
+                    err = RuntimeError(
+                        "malformed or short peer response batch")
+                    sp = None
+            if sp is None:
+                self.metrics.check_error_counter.labels(
+                    error="peer_forward").inc(int(idxs.size))
+                z32 = np.zeros(idxs.size, np.int32)
+                z64 = np.zeros(idxs.size, np.int64)
+                ebytes = _wire_native.build_rate_limit_resps(
+                    z32, z64, z64, z64,
+                    [f"while fetching rate limit from peer: {err}"]
+                    * int(idxs.size))
+                eo, el, _ = _wire_native.split_resp_items(ebytes)
+                for j, i in enumerate(idxs):
+                    item_tlvs[int(i)] = ebytes[int(eo[j]):int(eo[j] + el[j])]
+                continue
+            ro, rl, rs = sp
+            over += int((rs == 1).sum())
+            for j, i in enumerate(idxs):
+                item_tlvs[int(i)] = rbytes[int(ro[j]):int(ro[j] + rl[j])]
+
+        self.metrics.over_limit_counter.inc(over)
+        return b"".join(item_tlvs)
 
     def _get_rate_limits(self, reqs, now) -> List[RateLimitResponse]:
         n = len(reqs)
@@ -748,7 +880,12 @@ class V1Instance:
             if not qualifies or not hs.matches_pinned(kh, req):
                 # config changed or a flagged request (RESET/DRAIN/…)
                 # arrived: migrate hot state back so the standard path
-                # operates on the live values, not the promotion-time row
+                # operates on the live values, not the promotion-time row.
+                # Counted: one flagged request on a hot key silently
+                # forfeits the psum tier for it — operators should see it
+                self.metrics.hot_demotion_counter.labels(
+                    reason="flagged" if not qualifies
+                    else "config_change").inc()
                 self._demote(kh)
                 return False
             hot.append((i, kh))
@@ -820,6 +957,8 @@ class V1Instance:
         khs = list(hs.slots.keys())
         if not khs:
             return
+        self.metrics.hot_demotion_counter.labels(
+            reason="membership_change").inc(len(khs))
         hs.sync()
         rows = [(kh, hs.row_state(kh)) for kh in khs]
         rows = [(kh, r) for kh, r in rows if r is not None]

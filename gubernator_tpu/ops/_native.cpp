@@ -189,19 +189,28 @@ static inline bool read_varint(const uint8_t** p, const uint8_t* end,
 // parse_get_rate_limits(bytes) ->
 //   None                                  (needs the pb2 fallback path)
 // | (n, khash_raw u64le, hits i64le, limit i64le, duration i64le,
-//    algorithm i32le, behavior i32le, burst i64le, behavior_or)
+//    algorithm i32le, behavior i32le, burst i64le, behavior_or,
+//    tlv_off u64le, tlv_len u64le)
+// tlv_off/tlv_len delimit each complete `requests` TLV (tag byte through
+// payload end) in the input: a clustered daemon forwards a sub-batch to
+// its owner by concatenating those slices verbatim — the peer wire's
+// GetPeerRateLimitsReq.requests uses the same field number (1), so the
+// framing is byte-compatible (proto/peers.proto).
 static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
   Py_buffer view;
   if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
-  const uint8_t* p = (const uint8_t*)view.buf;
+  const uint8_t* base = (const uint8_t*)view.buf;
+  const uint8_t* p = base;
   const uint8_t* end = p + view.len;
   std::vector<uint64_t> khash;
   std::vector<int64_t> hits, limit, duration, burst;
   std::vector<int32_t> alg, beh;
+  std::vector<uint64_t> tlv_off, tlv_len;
   khash.reserve(64);
   uint64_t beh_or = 0;
   bool fallback = false;
   while (p < end) {
+    const uint8_t* tlv_start = p;
     uint64_t tag;
     if (!read_varint(&p, end, &tag) || tag != 0x0A) {  // field 1, LEN
       fallback = true;
@@ -286,6 +295,8 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
     alg.push_back(f_alg);
     beh.push_back(f_beh);
     beh_or |= (uint64_t)(uint32_t)f_beh;
+    tlv_off.push_back((uint64_t)(tlv_start - base));
+    tlv_len.push_back((uint64_t)(qend - tlv_start));
   }
   PyBuffer_Release(&view);
   if (fallback) Py_RETURN_NONE;
@@ -300,11 +311,102 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
   const char* al_p = n ? (const char*)alg.data() : kEmpty;
   const char* be_p = n ? (const char*)beh.data() : kEmpty;
   const char* bu_p = n ? (const char*)burst.data() : kEmpty;
+  const char* to_p = n ? (const char*)tlv_off.data() : kEmpty;
+  const char* tl_p = n ? (const char*)tlv_len.data() : kEmpty;
   PyObject* out = Py_BuildValue(
-      "(ny#y#y#y#y#y#y#K)", n, kh_p, n * 8, hi_p, n * 8, li_p, n * 8,
+      "(ny#y#y#y#y#y#y#Ky#y#)", n, kh_p, n * 8, hi_p, n * 8, li_p, n * 8,
       du_p, n * 8, al_p, n * 4, be_p, n * 4, bu_p, n * 8,
-      (unsigned long long)beh_or);
+      (unsigned long long)beh_or, to_p, n * 8, tl_p, n * 8);
   return out;
+}
+
+// split_resp_items(bytes) ->
+//   None | (n, tlv_off u64le, tlv_len u64le, status i32le)
+// Delimits each repeated field-1 submessage (RateLimitResp) of a
+// GetRateLimitsResp / GetPeerRateLimitsResp (both use field 1 —
+// proto/gubernator.proto, proto/peers.proto), and extracts each item's
+// status (field 1 varint; 0 when omitted).  The clustered wire lane
+// merges peer response TLVs into the client response by slicing these
+// ranges — no pb2 objects.  Returns None on malformed input or unknown
+// top-level fields (caller falls back to pb2).
+static PyObject* split_resp_items(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  const uint8_t* base = (const uint8_t*)view.buf;
+  const uint8_t* p = base;
+  const uint8_t* end = p + view.len;
+  std::vector<uint64_t> tlv_off, tlv_len;
+  std::vector<int32_t> status;
+  bool fallback = false;
+  while (p < end) {
+    const uint8_t* tlv_start = p;
+    uint64_t tag;
+    if (!read_varint(&p, end, &tag) || tag != 0x0A) {  // field 1, LEN
+      fallback = true;
+      break;
+    }
+    uint64_t len;
+    if (!read_varint(&p, end, &len) || (uint64_t)(end - p) < len) {
+      fallback = true;
+      break;
+    }
+    const uint8_t* q = p;
+    const uint8_t* qend = p + len;
+    p = qend;
+    int32_t st = 0;
+    // scan the submessage for field 1 (status); skip everything else
+    while (q < qend) {
+      uint64_t t;
+      if (!read_varint(&q, qend, &t)) {
+        fallback = true;
+        break;
+      }
+      uint64_t field = t >> 3, wt = t & 7;
+      if (wt == 0) {
+        uint64_t v;
+        if (!read_varint(&q, qend, &v)) {
+          fallback = true;
+          break;
+        }
+        if (field == 1) st = (int32_t)v;
+      } else if (wt == 2) {
+        uint64_t l;
+        if (!read_varint(&q, qend, &l) || (uint64_t)(qend - q) < l) {
+          fallback = true;
+          break;
+        }
+        q += l;
+      } else if (wt == 1) {
+        if (qend - q < 8) {
+          fallback = true;
+          break;
+        }
+        q += 8;
+      } else if (wt == 5) {
+        if (qend - q < 4) {
+          fallback = true;
+          break;
+        }
+        q += 4;
+      } else {
+        fallback = true;
+        break;
+      }
+    }
+    if (fallback) break;
+    tlv_off.push_back((uint64_t)(tlv_start - base));
+    tlv_len.push_back((uint64_t)(qend - tlv_start));
+    status.push_back(st);
+  }
+  PyBuffer_Release(&view);
+  if (fallback) Py_RETURN_NONE;
+  Py_ssize_t n = (Py_ssize_t)tlv_off.size();
+  static const char kEmpty2[1] = {0};
+  const char* to_p = n ? (const char*)tlv_off.data() : kEmpty2;
+  const char* tl_p = n ? (const char*)tlv_len.data() : kEmpty2;
+  const char* st_p = n ? (const char*)status.data() : kEmpty2;
+  return Py_BuildValue("(ny#y#y#)", n, to_p, n * 8, tl_p, n * 8, st_p,
+                       n * 4);
 }
 
 static inline void put_varint(std::vector<uint8_t>& out, uint64_t v) {
@@ -400,6 +502,8 @@ static PyMethodDef methods[] = {
      "Batch FNV-1a64 of name+'_'+key pairs -> (le64 bytes, n)"},
     {"parse_get_rate_limits", parse_get_rate_limits, METH_O,
      "GetRateLimitsReq wire bytes -> packed column buffers (or None)"},
+    {"split_resp_items", split_resp_items, METH_O,
+     "RateLimitResp-list wire bytes -> per-item TLV ranges + status"},
     {"build_rate_limit_resps", build_rate_limit_resps, METH_VARARGS,
      "Packed response columns -> GetRateLimitsResp wire bytes"},
     {nullptr, nullptr, 0, nullptr}};

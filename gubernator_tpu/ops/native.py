@@ -32,7 +32,7 @@ def parse_get_rate_limits(data: bytes):
     r = _native.parse_get_rate_limits(data)
     if r is None:
         return None
-    n, kh, hits, limit, dur, alg, beh, burst, beh_or = r
+    n, kh, hits, limit, dur, alg, beh, burst, beh_or, toff, tlen = r
     return {
         "n": n,
         "khash_raw": np.frombuffer(kh, "<u8", count=n),
@@ -43,7 +43,26 @@ def parse_get_rate_limits(data: bytes):
         "behavior": np.frombuffer(beh, "<i4", count=n),
         "burst": np.frombuffer(burst, "<i8", count=n),
         "behavior_or": int(beh_or),
+        # per-request TLV ranges in the input bytes: a clustered daemon
+        # forwards owner sub-batches by slicing these verbatim (peer
+        # wire framing is byte-compatible, field 1 on both messages)
+        "tlv_off": np.frombuffer(toff, "<u8", count=n),
+        "tlv_len": np.frombuffer(tlen, "<u8", count=n),
     }
+
+
+def split_resp_items(data: bytes):
+    """RateLimitResp-list wire bytes → (tlv_off, tlv_len, status) per
+    item, or None on malformed input (caller falls back to pb2).  Works
+    for GetRateLimitsResp and GetPeerRateLimitsResp alike (both carry
+    the repeated submessage on field 1)."""
+    r = _native.split_resp_items(data)
+    if r is None:
+        return None
+    n, toff, tlen, st = r
+    return (np.frombuffer(toff, "<u8", count=n),
+            np.frombuffer(tlen, "<u8", count=n),
+            np.frombuffer(st, "<i4", count=n))
 
 
 def build_rate_limit_resps(status: np.ndarray, limit: np.ndarray,

@@ -43,6 +43,7 @@ class PeerClient:
         self._metrics = metrics
         self._channel: Optional[grpc.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
+        self._raw_peer_call = None  # bytes-in/bytes-out GetPeerRateLimits
         self._queue: "queue.Queue[tuple[RateLimitRequest, Future]]" = queue.Queue()
         self._closing = threading.Event()
         self._lock = threading.Lock()
@@ -97,6 +98,28 @@ class PeerClient:
             timeout_s = self.behaviors.batch_timeout_ms / 1000.0 + 60.0
         resp = stub.GetPeerRateLimits(msg, timeout=timeout_s)
         return [resp_from_pb(m) for m in resp.rate_limits]
+
+    def get_peer_rate_limits_raw_future(self, data: bytes,
+                                        timeout_s: Optional[float] = None):
+        """Forward an already-serialized GetPeerRateLimitsReq and return
+        a grpc Future resolving to raw GetPeerRateLimitsResp bytes.
+
+        The clustered wire fast lane (instance.py › _wire_check_clustered)
+        builds ``data`` by concatenating request TLV slices from the
+        client's own wire bytes — no pb2 objects on either side; the
+        owner daemon's columnar peer lane decodes them in C."""
+        if self._closing.is_set():
+            raise ErrClosing("peer client is closing")
+        self._ensure_stub()
+        with self._lock:
+            if self._raw_peer_call is None:
+                # identity (de)serializers: bytes straight through
+                self._raw_peer_call = self._channel.unary_unary(
+                    "/pb.gubernator.PeersV1/GetPeerRateLimits")
+            call = self._raw_peer_call
+        if timeout_s is None:
+            timeout_s = self.behaviors.batch_timeout_ms / 1000.0 + 60.0
+        return call.future(data, timeout=timeout_s)
 
     def update_peer_globals(self, updates: Sequence[peers_pb.UpdatePeerGlobal]
                             ) -> None:
@@ -173,4 +196,4 @@ class PeerClient:
         with self._lock:
             if self._channel is not None:
                 self._channel.close()
-                self._channel = self._stub = None
+                self._channel = self._stub = self._raw_peer_call = None

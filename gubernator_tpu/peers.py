@@ -21,6 +21,8 @@ import bisect
 import hashlib
 from typing import Callable, Dict, Generic, List, Optional, TypeVar
 
+import numpy as np
+
 from .hashing import fnv1a64, mixed_fnv1a64
 from .types import PeerInfo
 
@@ -80,6 +82,20 @@ class ConsistentHash(Generic[P]):
             raise RuntimeError("picker has no peers")
         return self._peers[h % len(self._peers)]
 
+    def owner_indices(self, hashes: np.ndarray) -> np.ndarray:
+        """Vectorized get_by_hash: int32 index into ``peers()`` order per
+        uint64 key hash (the clustered wire fast lane's ring split —
+        instance.py › _wire_check_clustered).  Same hash-pipeline caveat
+        as get_by_hash."""
+        if not self._peers:
+            raise RuntimeError("picker has no peers")
+        kh = np.asarray(hashes, np.uint64)
+        return (kh % np.uint64(len(self._peers))).astype(np.int32)
+
+    def owner_peers(self) -> List[P]:
+        """The peer list ``owner_indices`` results index into."""
+        return list(self._peers)
+
 
 class ReplicatedConsistentHash(Generic[P]):
     """Virtual-node hash ring.
@@ -112,10 +128,14 @@ class ReplicatedConsistentHash(Generic[P]):
         for i in range(self.replicas):
             h = self._hash(f"{addr}{i}".encode("utf-8"))
             self._points[h] = peer
-        # rebuild sorted views
+        # rebuild sorted views (+ numpy mirrors for owner_indices)
         items = sorted(self._points.items())
         self._ring = [h for h, _ in items]
         self._ring_peer = [p for _, p in items]
+        pos = {id(p): i for i, p in enumerate(self._peers)}
+        self._ring_np = np.asarray(self._ring, dtype=np.uint64)
+        self._ring_peer_idx = np.asarray(
+            [pos[id(p)] for p in self._ring_peer], dtype=np.int32)
 
     def peers(self) -> List[P]:
         return list(self._peers)
@@ -137,6 +157,22 @@ class ReplicatedConsistentHash(Generic[P]):
         if idx == len(self._ring):
             idx = 0
         return self._ring_peer[idx]
+
+    def owner_indices(self, hashes: np.ndarray) -> np.ndarray:
+        """Vectorized get_by_hash over the vnode ring: int32 index into
+        ``peers()`` order per uint64 key hash.  np.searchsorted(side=
+        "left") is exactly bisect_left, so this agrees with get()/
+        get_by_hash bit-for-bit."""
+        if not self._ring:
+            raise RuntimeError("picker has no peers")
+        idx = np.searchsorted(self._ring_np, np.asarray(hashes, np.uint64),
+                              side="left")
+        idx = np.where(idx == len(self._ring_np), 0, idx)
+        return self._ring_peer_idx[idx]
+
+    def owner_peers(self) -> List[P]:
+        """The peer list ``owner_indices`` results index into."""
+        return list(self._peers)
 
 
 class RegionPeerPicker(Generic[P]):
@@ -190,6 +226,16 @@ class RegionPeerPicker(Generic[P]):
 
     def get_by_hash(self, h: int) -> P:
         return self._local_picker().get_by_hash(h)  # type: ignore
+
+    def owner_indices(self, hashes: np.ndarray) -> np.ndarray:
+        """Vectorized get() over the local region's ring; indices refer
+        to ``owner_peers()`` order (NOT ``peers()``, which spans every
+        region)."""
+        return self._local_picker().owner_indices(hashes)  # type: ignore
+
+    def owner_peers(self) -> List[P]:
+        """The peer list ``owner_indices`` results index into."""
+        return self._local_picker().peers()  # type: ignore
 
     def get_in_region(self, key: str, dc: str) -> Optional[P]:
         picker = self.regions.get(dc)

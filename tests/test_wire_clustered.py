@@ -1,0 +1,183 @@
+"""Clustered wire fast lane (VERDICT r1 item 4): client-facing
+GetRateLimits must take the columnar lane end-to-end in a multi-daemon
+cluster — C++ parse → ring split by owner → raw-TLV forwards over the
+peer wire → ordered response splice — with oracle parity.
+
+Round 1's lane required `not self.peers()` (instance.py), so every real
+cluster fell back to per-request pb2 objects on the client path; these
+tests pin the fix.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import Algorithm, Behavior, Oracle, RateLimitRequest
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.peers import ConsistentHash, ReplicatedConsistentHash
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.types import PeerInfo
+
+HOUR = 3_600_000
+DAY = 24 * HOUR
+
+
+def clock_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def serialize(reqs):
+    msg = pb.GetRateLimitsReq()
+    for r in reqs:
+        m = msg.requests.add()
+        m.name = r.name
+        m.unique_key = r.unique_key
+        m.hits = r.hits
+        m.limit = r.limit
+        m.duration = r.duration
+        m.algorithm = int(r.algorithm)
+        m.behavior = int(r.behavior)
+        m.burst = r.burst
+    return msg.SerializeToString()
+
+
+def lane_count(inst, lane: str) -> float:
+    return inst.metrics.wire_lane_counter.labels(lane=lane)._value.get()
+
+
+def mk_wave(w: int):
+    """Mixed token/leaky requests over many keys incl. in-batch
+    duplicates; durations long enough that wall-clock skew between
+    daemons cannot move a token boundary during the test."""
+    reqs = []
+    for i in range(40):
+        reqs.append(RateLimitRequest(
+            name="wcl", unique_key=f"t{i}", hits=1 + (i + w) % 3, limit=9,
+            duration=DAY, algorithm=Algorithm.TOKEN_BUCKET))
+    for i in range(12):
+        reqs.append(RateLimitRequest(
+            name="wcl", unique_key=f"l{i}", hits=2, limit=40,
+            duration=DAY, algorithm=Algorithm.LEAKY_BUCKET, burst=12))
+    # duplicates of a few keys inside the same batch (segment semantics
+    # must survive the forward/merge round trip)
+    for i in range(6):
+        reqs.append(RateLimitRequest(
+            name="wcl", unique_key=f"t{i}", hits=2, limit=9,
+            duration=DAY, algorithm=Algorithm.TOKEN_BUCKET))
+    return reqs
+
+
+class TestClusteredWireLane:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        c = cluster_mod.start(3)
+        yield c
+        c.stop()
+
+    def test_oracle_parity_and_lane(self, cluster):
+        inst = cluster.instance_at(0)
+        oracle = Oracle()
+        before = lane_count(inst, "wire_clustered")
+        fallback_before = lane_count(inst, "pb2_fallback")
+        for w in range(4):
+            reqs = mk_wave(w)
+            now = clock_ms()
+            want = oracle.check_batch(reqs, now)
+            out = pb.GetRateLimitsResp.FromString(
+                inst.get_rate_limits_wire(serialize(reqs), now_ms=now))
+            assert len(out.responses) == len(reqs)
+            for i, (g, e) in enumerate(zip(out.responses, want)):
+                assert g.error == "", (w, i, g.error)
+                assert (int(g.status), int(g.remaining), int(g.limit)) == \
+                    (int(e.status), int(e.remaining), int(e.limit)), \
+                    (w, i, reqs[i])
+                # forwarded keys are served on the owner's clock; allow
+                # wall-clock skew but not truncation
+                assert abs(int(g.reset_time) - int(e.reset_time)) < 60_000
+        n_total = 4 * len(mk_wave(0))
+        assert lane_count(inst, "wire_clustered") - before == n_total
+        assert lane_count(inst, "pb2_fallback") == fallback_before
+        # at least one owner actually served forwarded columns over the
+        # peer wire lane (keys spread across 3 daemons)
+        peer_wire = sum(lane_count(cluster.instance_at(i), "peer_wire")
+                        for i in range(3))
+        assert peer_wire > 0
+
+    def test_remote_over_limit_counted_and_consistent(self, cluster):
+        """One key hammered through daemon 0 must enforce its limit
+        exactly once cluster-wide regardless of which daemon owns it."""
+        inst = cluster.instance_at(0)
+        key = "hammer"
+        reqs = [RateLimitRequest(name="wcl2", unique_key=key, hits=1,
+                                 limit=5, duration=DAY)] * 8
+        now = clock_ms()
+        out = pb.GetRateLimitsResp.FromString(
+            inst.get_rate_limits_wire(serialize(reqs), now_ms=now))
+        statuses = [int(r.status) for r in out.responses]
+        assert statuses == [0] * 5 + [1] * 3
+        remaining = [int(r.remaining) for r in out.responses]
+        assert remaining[:5] == [4, 3, 2, 1, 0]
+
+    def test_dead_peer_degrades_per_subbatch(self, cluster):
+        """Requests owned by a dead peer get error responses; everything
+        else still succeeds (object-path forward-error semantics)."""
+        inst = cluster.instance_at(0)
+        # find keys owned by daemon 2 vs daemon 0
+        owned2, owned_other = [], []
+        for i in range(200):
+            k = f"dp{i}"
+            d = cluster.owner_daemon_of("wcl3_" + k)
+            (owned2 if d is cluster.daemon_at(2) else owned_other).append(k)
+            if len(owned2) >= 5 and len(owned_other) >= 5:
+                break
+        assert owned2 and owned_other
+        cluster.daemon_at(2).close()
+        try:
+            reqs = [RateLimitRequest(name="wcl3", unique_key=k, hits=1,
+                                     limit=10, duration=DAY)
+                    for k in owned2[:5] + owned_other[:5]]
+            out = pb.GetRateLimitsResp.FromString(
+                inst.get_rate_limits_wire(serialize(reqs),
+                                          now_ms=clock_ms()))
+            by_key = dict(zip(owned2[:5] + owned_other[:5], out.responses))
+            for k in owned2[:5]:
+                assert "while fetching rate limit from peer" in \
+                    by_key[k].error
+            for k in owned_other[:5]:
+                assert by_key[k].error == ""
+                assert int(by_key[k].remaining) == 9
+        finally:
+            # restore daemon 2 for any later test using the fixture
+            cluster.restart(2)
+
+
+class TestOwnerIndices:
+    """owner_indices must agree bit-for-bit with get()/get_by_hash."""
+
+    class _Peer:
+        def __init__(self, addr):
+            self.info = PeerInfo(grpc_address=addr)
+
+    @pytest.mark.parametrize("picker_cls",
+                             [ConsistentHash, ReplicatedConsistentHash])
+    def test_matches_scalar(self, picker_cls):
+        picker = picker_cls()
+        for i in range(5):
+            picker.add(self._Peer(f"10.0.0.{i}:81"))
+        rng = np.random.default_rng(7)
+        hashes = rng.integers(0, 2**64, size=4096, dtype=np.uint64)
+        idx = picker.owner_indices(hashes)
+        peers = picker.owner_peers()
+        for h, j in zip(hashes.tolist(), idx.tolist()):
+            assert picker.get_by_hash(h) is peers[j]
+
+    def test_ring_edges(self):
+        picker = ReplicatedConsistentHash()
+        for i in range(3):
+            picker.add(self._Peer(f"10.0.0.{i}:81"))
+        edge = np.array([0, 1, 2**64 - 1, picker._ring[0],
+                         picker._ring[-1]], dtype=np.uint64)
+        idx = picker.owner_indices(edge)
+        peers = picker.owner_peers()
+        for h, j in zip(edge.tolist(), idx.tolist()):
+            assert picker.get_by_hash(h) is peers[j]

@@ -1,13 +1,18 @@
-"""Benchmark: rate-limit decisions/sec/chip on BASELINE config 3.
+"""Benchmark: rate-limit decisions/sec/chip on the north-star workload.
 
-Workload: TOKEN_BUCKET, 1M distinct keys drawn Zipf(1.1), hits=1,
-limit=100, duration=10s — the reference's `gubernator-cli` load shape
-(BASELINE.md config 3; client batches of 1000).  The dispatcher coalesces
-client batches into one device batch per step (the service does the same
-under load); each step is one plain-jit program — probe → gather →
-branchless update → scatter — whose table writes XLA fuses into a dense
-streaming copy (the TPU-idiomatic fast path; see core/step.py ›
-decide_batch for why the buffers are deliberately not donated).
+Workload (BASELINE.json › north_star): TOKEN_BUCKET, 10M distinct keys
+drawn Zipf(1.1), hits=1, limit=100, duration=10s — the reference's
+`gubernator-cli` load shape at the 10M-key working set (client batches
+of 1000).  The dispatcher coalesces client batches into one device batch
+per step; each step is one jit program — probe → gather → branchless
+update → scatter.  TWO table-update modes are measured and the faster
+one is the headline (extra.step_mode records which):
+
+- "copy": no donation; scatters fuse into a dense streaming copy of the
+  table (~2 × CAP × row-bytes per launch).
+- "donate": table aliases in/out; cond-gated cold columns pass through
+  copy-free and hot scatters update in place where the lowering allows
+  (core/step.py › decide_batch_donated) — per-step traffic ~B-sized.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
@@ -32,16 +37,16 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-#: 2M rows for 1M keys (load factor 0.5); GUBER_BENCH_CAP overrides for
-#: capacity sweeps (table streaming is the per-step cost floor: the
-#: no-donation step copies the whole SoA table each launch)
-CAP = int(os.environ.get("GUBER_BENCH_CAP", 1 << 21))
-#: device batch = coalesced client batches of 1024 (GUBER_BENCH_B overrides
-#: for batch-size sweeps; GUBER_BENCH_FAST=1 shrinks the program for
-#: cold-compile-constrained runs)
-B = int(os.environ.get("GUBER_BENCH_B",
-                       8192 if os.environ.get("GUBER_BENCH_FAST") else 65536))
-N_KEYS = 1_000_000
+FAST = bool(os.environ.get("GUBER_BENCH_FAST"))
+#: north star is 10M keys; CAP 2^24 = load factor ~0.6.  The CPU
+#: fallback (GUBER_BENCH_FAST) shrinks the workload — its config string
+#: says so; it never silently stands in for the 10M-key number.
+N_KEYS = int(os.environ.get("GUBER_BENCH_KEYS",
+                            1_000_000 if FAST else 10_000_000))
+CAP = int(os.environ.get("GUBER_BENCH_CAP", 1 << 21 if FAST else 1 << 24))
+#: device batch = coalesced client batches of 1024 (GUBER_BENCH_B
+#: overrides for batch-size sweeps)
+B = int(os.environ.get("GUBER_BENCH_B", 8192 if FAST else 65536))
 ZIPF_A = 1.1
 LIMIT = 100
 DURATION_MS = 10_000
@@ -71,7 +76,7 @@ def main():
     import jax.numpy as jnp
 
     from gubernator_tpu.core.batch import RequestBatch
-    from gubernator_tpu.core.step import decide_batch
+    from gubernator_tpu.core.step import decide_batch, decide_batch_donated
     from gubernator_tpu.core.table import init_table
 
     backend = jax.default_backend()
@@ -99,31 +104,43 @@ def main():
     def make_batch(keys):
         return RequestBatch(key=keys, **const)
 
-    state = init_table(CAP)
+    def measure_mode(step_fn, label, sustain_target=15_000_000):
+        """Warm up a fresh table, then time a sustained dispatch loop."""
+        st = init_table(CAP)
+        t0 = time.perf_counter()
+        st, out = step_fn(st, make_batch(key_batches[0]),
+                          jnp.asarray(NOW0, i64))
+        out.status.block_until_ready()
+        log(f"[{label}] compile+first step in "
+            f"{time.perf_counter() - t0:.1f}s")
+        for i in range(1, n_batches):
+            st, out = step_fn(st, make_batch(key_batches[i]),
+                              jnp.asarray(NOW0 + i, i64))
+        out.status.block_until_ready()
+        reps = max(1, int(sustain_target / B / n_batches)) * n_batches
+        t0 = time.perf_counter()
+        for r in range(reps):
+            st, out = step_fn(st, make_batch(key_batches[r % n_batches]),
+                              jnp.asarray(NOW0 + 100 + r, i64))
+        out.status.block_until_ready()
+        dt = time.perf_counter() - t0
+        rate = reps * B / dt
+        log(f"[{label}] sustained: {reps * B} decisions in {dt:.3f}s "
+            f"→ {rate/1e6:.2f}M/s")
+        return rate, st
 
-    log("warmup/compile...")
-    t0 = time.perf_counter()
-    state, out = decide_batch(state, make_batch(key_batches[0]),
-                              jnp.asarray(NOW0, i64))
-    out.status.block_until_ready()
-    log(f"compile+first step in {time.perf_counter() - t0:.1f}s")
-    # populate the table / steady state
-    for i in range(1, n_batches):
-        state, out = decide_batch(state, make_batch(key_batches[i]),
-                                  jnp.asarray(NOW0 + i, i64))
-    out.status.block_until_ready()
-
-    # sustained throughput: host dispatch loop, ≥15M decisions
-    reps = max(1, int(15_000_000 / B / n_batches)) * n_batches
-    t0 = time.perf_counter()
-    for r in range(reps):
-        state, out = decide_batch(state, make_batch(key_batches[r % n_batches]),
-                                  jnp.asarray(NOW0 + 100 + r, i64))
-    out.status.block_until_ready()
-    dt = time.perf_counter() - t0
-    total = reps * B
-    dps = total / dt
-    log(f"sustained: {total} decisions in {dt:.3f}s → {dps/1e6:.2f}M/s")
+    # mode 1: dense-copy step (safe everywhere)
+    dps_copy, state = measure_mode(decide_batch, "copy")
+    # mode 2: donated step — in-place updates where the lowering allows;
+    # this is the mode that breaks the CAP-linear streaming wall
+    try:
+        dps_donate, _ = measure_mode(decide_batch_donated, "donate")
+    except Exception as e:  # noqa: BLE001
+        dps_donate = 0.0
+        log(f"donated-step mode failed: {e!r:.200}")
+    step_mode = "donate" if dps_donate > dps_copy else "copy"
+    dps = max(dps_copy, dps_donate)
+    log(f"headline mode: {step_mode} ({dps/1e6:.2f}M/s)")
 
     # device-resident superstep: lax.scan chains R batches in ONE launch,
     # so per-launch dispatch latency (µs locally, ~0.5 ms over a
@@ -207,11 +224,15 @@ def main():
     configs = run_secondary_configs(jnp, decide_batch, const)
 
     print(json.dumps({
-        "metric": "rate-limit decisions/sec/chip @1M-key Zipf(1.1)",
+        "metric": (f"rate-limit decisions/sec/chip @{N_KEYS//1_000_000}M-key"
+                   f" Zipf({ZIPF_A})"),
         "value": round(dps),
         "unit": "decisions/s",
         "vs_baseline": round(dps / TARGET, 4),
         "extra": {
+            "step_mode": step_mode,
+            "copy_mode_decisions_per_s": round(dps_copy),
+            "donate_mode_decisions_per_s": round(dps_donate),
             "device_scan_decisions_per_s": round(dps_scan),
             "p50_ms": round(p50, 3),
             "p99_ms": round(p99, 3),
@@ -432,21 +453,18 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
     except Exception as e:  # noqa: BLE001
         out["7_hot_psum"] = {"error": str(e)[:200]}
 
-    # -- config 5: huge multi-tenant table, Gregorian resets +
-    # RESET_REMAINING churn.  Capacity sized to the chip's memory
-    # budget: ~72 B/row and the no-donation step keeps TWO copies of
-    # the table live (input + streamed output), so pick the largest
-    # power of two with 2 × cap × 72 B within ~80% of HBM.
+    # -- config 5: huge multi-tenant table (100M keys → CAP 2^27),
+    # Gregorian resets + RESET_REMAINING churn.  The TRUE BASELINE.json
+    # capacity is attempted — never silently downscaled (VERDICT r1
+    # item 3): the donated step keeps ONE copy of the ~9 GB table live
+    # (in-place/pass-through updates), which is what makes 2^27 fit a
+    # 16 GB chip at all.  A failure (OOM, lowering) is recorded as an
+    # error row, honestly.  The CPU fallback uses a reduced capacity and
+    # says so via "cpu_reduced".
+    cpu5 = jax.default_backend() == "cpu"
+    cap5 = 1 << 22 if cpu5 else 1 << 27
     try:
-        if jax.default_backend() == "cpu":
-            cap5 = 1 << 22
-        else:
-            try:
-                budget = jax.devices()[0].memory_stats()["bytes_limit"]
-            except Exception:  # noqa: BLE001 - stats not exposed
-                budget = 12 << 30  # conservative v5e-class default
-            cap5 = 1 << int(np.log2(budget * 0.8 / (2 * 72)))
-            cap5 = min(cap5, 1 << 27)
+        from gubernator_tpu.core.step import decide_batch_donated
         n_keys5 = int(cap5 * 0.75)
         st5 = init_table(cap5)
         greg_end = gregorian_expiration(NOW0, int(GregorianDuration.HOURS))
@@ -461,12 +479,16 @@ def run_secondary_configs(jnp, decide_batch, const_proto):
                 eff_ms=jnp.full(B, 3_600_000, i64),
                 greg_end=jnp.full(B, greg_end, i64),
                 behavior=jnp.asarray(beh_col)))
-        st5, _ = decide_batch(st5, batches[0], jnp.asarray(NOW0, i64))
-        dps5, _ = _sustain(decide_batch, jnp, st5, batches, 16, NOW0 + 1)
+        st5, _ = decide_batch_donated(st5, batches[0],
+                                      jnp.asarray(NOW0, i64))
+        dps5, _ = _sustain(decide_batch_donated, jnp, st5, batches, 16,
+                           NOW0 + 1)
         out["5_gregorian_churn"] = {"decisions_per_s": round(dps5),
-                                    "capacity": cap5}
+                                    "capacity": cap5,
+                                    "cpu_reduced": cpu5}
     except Exception as e:  # noqa: BLE001
-        out["5_gregorian_churn"] = {"error": str(e)[:200]}
+        out["5_gregorian_churn"] = {"error": str(e)[:200],
+                                    "capacity_attempted": int(cap5)}
     return out
 
 
@@ -479,7 +501,8 @@ def _watchdog_main():
     """
     import subprocess
 
-    deadline = int(os.environ.get("GUBER_BENCH_TIMEOUT", "2700"))
+    # two headline compiles (copy + donated) can both be cold on TPU
+    deadline = int(os.environ.get("GUBER_BENCH_TIMEOUT", "4500"))
     env = dict(os.environ, GUBER_BENCH_INNER="1")
 
     def attempt(extra_env, timeout):
@@ -511,7 +534,7 @@ def _watchdog_main():
             out = json.dumps(d)
     if out is None:
         out = json.dumps({
-            "metric": "rate-limit decisions/sec/chip @1M-key Zipf(1.1)",
+            "metric": "rate-limit decisions/sec/chip @10M-key Zipf(1.1)",
             "value": 0, "unit": "decisions/s", "vs_baseline": 0.0,
             "extra": {"error": "all bench attempts failed or timed out"}})
     print(out)

@@ -188,12 +188,16 @@ def responses_from_columns(cols, errors=None):
     return out
 
 
-def make_sharded_step(mesh):
+def make_sharded_step(mesh, donate: bool = False):
     """jit-compiled sharded step: (state, batch, now) → (state, outputs).
 
     state/batch arrays are globally [n·cap_local] / [n·B] with block d on
     device d; outputs keep that layout; counters are psum-reduced across
     the mesh (the only collective on the hot path — metrics, not data).
+
+    ``donate`` aliases the table in/out (see core/step.py ›
+    decide_batch_donated for the trade-off); callers must then thread
+    state linearly.
     """
     S = SHARD_AXIS
 
@@ -209,10 +213,7 @@ def make_sharded_step(mesh):
         in_specs=(P(S), P(S), P()),
         out_specs=(P(S), P(S), P()),
     )
-    # No donation: aliased table buffers force serial in-place scatters on
-    # TPU; unaliased, the scatters fuse into a dense streaming copy (see
-    # core/step.py › decide_batch).
-    return jax.jit(sharded)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 #: Packed-transfer wire layout for the serving step: every RequestBatch
@@ -241,7 +242,7 @@ def pack_wave_host(b: RequestBatch) -> tuple[np.ndarray, np.ndarray]:
     return a64, a32
 
 
-def make_sharded_step_packed(mesh):
+def make_sharded_step_packed(mesh, donate: bool = False):
     """The serving twin of make_sharded_step over the packed wire layout
     (see PACK64/PACK32): (state, a64, a32, now) → (state, [5,B] i64
     outputs, (over, insert) counters)."""
@@ -266,7 +267,7 @@ def make_sharded_step_packed(mesh):
         in_specs=(P(S), P(None, S), P(None, S), P()),
         out_specs=(P(S), P(None, S), P()),
     )
-    return jax.jit(sharded)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 class ShardedEngine:
@@ -287,7 +288,15 @@ class ShardedEngine:
         #: neither do we until this bound.
         self.auto_grow_limit = auto_grow_limit
         self.state = shard_table(self.mesh, capacity_per_shard)
-        self._step = make_sharded_step_packed(self.mesh)
+        # GUBER_STEP_DONATE=1 aliases the table in/out on the serving
+        # step (clean-step cold columns then pass through copy-free; see
+        # core/step.py › decide_batch_donated).  Off by default until
+        # the backend's in-place scatter lowering is measured fast
+        # (bench.py records both modes).
+        import os as _os
+        self._step = make_sharded_step_packed(
+            self.mesh,
+            donate=_os.environ.get("GUBER_STEP_DONATE", "0") == "1")
         self._batch_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
         self._mat_sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
         self._repl = NamedSharding(self.mesh, P())

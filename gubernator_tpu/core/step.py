@@ -345,12 +345,14 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     def uni(x):
         return seg_max(x) == seg(x)
 
-    uniform = (uni(sf.hits) & uni(sf.limit) & uni(sf.duration) & uni(sf.eff)
-               & uni(sf.behavior) & uni(sf.alg) & uni(sf.burst)
-               & uni(sf.now))  # mixed arrival times → per-position path
+    uniform_cfg = (uni(sf.hits) & uni(sf.limit) & uni(sf.duration)
+                   & uni(sf.eff) & uni(sf.behavior) & uni(sf.alg)
+                   & uni(sf.burst))
+    uni_now = uni(sf.now)
     any_flag = seg_max((sf.behavior & (_RESET | _DRAIN))) > 0
-    simple = exists & uniform & (~any_flag)
-    complex_seg = exists & (seg_len > 1) & (~simple)
+    # (simple/complex masks are finalized after the head apply: token
+    # segments with mixed arrival times can still take the closed form
+    # when no tail request crosses the head's window — see below)
 
     # ---- gather item state per segment ---------------------------------
     def grow(col, fill=0):
@@ -377,6 +379,18 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     # ---- simple tails: closed form, fully vectorized -------------------
     is_leaky0 = req0.alg == int(Algorithm.LEAKY_BUCKET)
+    # Mixed arrival times usually force the per-position path (leaky
+    # replenishes per request), but a TOKEN transition is time-invariant
+    # except for the expiry check: with uniform config/flags and every
+    # tail arrival inside the head's window (max now < item1.exp after
+    # the head applied), the decrement-only closed form is exact.  This
+    # keeps dispatcher-coalesced concurrent callers — distinct clocks,
+    # shared hot keys — on the vectorized path instead of a while_loop
+    # as long as the longest such segment (the serving common case).
+    time_safe = uni_now | ((~is_leaky0) & (seg_max(sf.now) < item1.exp))
+    uniform = uniform_cfg & time_safe
+    simple = exists & uniform & (~any_flag)
+    complex_seg = exists & (seg_len > 1) & (~simple)
     cost0 = req0.hits * jnp.where(is_leaky0, item1.eff, 1)
     k_raw = jnp.where(cost0 > 0, item1.rem // jnp.maximum(cost0, 1), _I64_MAX)
     tail_n = jnp.maximum(seg_len - 1, 0).astype(i64)

@@ -277,11 +277,22 @@ class ShardedEngine:
 
     def __init__(self, mesh=None, capacity_per_shard: int = 1 << 16,
                  batch_per_shard: int = 1024,
-                 auto_grow_limit: int = 0):
+                 auto_grow_limit: int = 0,
+                 wave_buckets: Sequence[int] | None = None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n = self.mesh.shape[SHARD_AXIS]
         self.cap_local = capacity_per_shard
         self.B = batch_per_shard
+        #: Wave-size buckets for check_packed: a pass picks the smallest
+        #: bucket covering its busiest shard, so a lone client batch
+        #:  rides the small fast program while dispatcher-coalesced
+        #: bursts amortize launch cost in one big wave instead of
+        #: ceil(n/B) small ones (the front-door throughput lever —
+        #: VERDICT r1 item 5).  Each bucket is one compiled program;
+        #: warmup() pre-compiles them all.
+        self.wave_buckets = (tuple(sorted(set(wave_buckets)))
+                             if wave_buckets
+                             else (batch_per_shard, batch_per_shard * 8))
         #: per-shard capacity ceiling for on-device auto-grow when probe
         #: windows stay exhausted after a sweep (0 = disabled).  The
         #: reference's LRU never fails an insert; with auto-grow on,
@@ -371,19 +382,134 @@ class ShardedEngine:
         return self._pallas_sweep_fn(self.state, jnp.asarray(now_ms,
                                                              jnp.int64))
 
-    def _run_wave(self, glob: RequestBatch, now_ms: int):
-        """One device launch over the packed wire layout: 2 uploads, the
-        step, 1 download.  Returns (status, remaining, reset, limit,
-        table_full) host arrays in [n·B] block order."""
+    def _build_waves(self, khash: np.ndarray, pending: np.ndarray):
+        """Route ``pending`` request indices into device waves.
+
+        Returns [(idx, slots, bw_w)]: original indices, block slots, and
+        the wave's bucket size.  Stable sorts keep request order inside
+        a shard (sequential parity for duplicate keys).  Waves split at
+        the largest bucket per shard; each wave then rides the smallest
+        bucket covering its own densest shard, so a coalesced burst
+        takes one big launch and its overflow tail a small one — never
+        a second nearly-empty big launch (see wave_buckets)."""
+        shard = shard_of(khash[pending], self.n)
+        order = np.argsort(shard, kind="stable")
+        s_sorted = shard[order]
+        starts = np.searchsorted(s_sorted, np.arange(self.n), "left")
+        posin = np.arange(len(pending)) - starts[s_sorted]
+        Bw = self.wave_buckets[-1]
+        wave_id = posin // Bw
+        waves = []
+        for w in range(int(wave_id.max()) + 1 if len(pending) else 0):
+            m = wave_id == w
+            idx = pending[order[m]]
+            wcnt = int(np.bincount(s_sorted[m], minlength=self.n).max())
+            bw_w = next((b for b in self.wave_buckets if wcnt <= b),
+                        self.wave_buckets[-1])
+            slots = s_sorted[m].astype(np.int64) * bw_w + posin[m] % Bw
+            waves.append((idx, slots, bw_w))
+        return waves
+
+    def _fill_glob(self, batch: RequestBatch, idx, slots, bw_w
+                   ) -> RequestBatch:
+        glob = empty_batch(self.n * bw_w)
+        for f in range(len(glob)):
+            np.asarray(glob[f])[slots] = np.asarray(batch[f])[idx]
+        return glob
+
+    def launch_packed(self, batch: RequestBatch, khash: np.ndarray,
+                      now_ms: int):
+        """Pipeline phase 1 of check_packed: route and LAUNCH the waves
+        without blocking on device results, so the dispatcher can
+        overlap the next wave's host work with this one's device time.
+        Returns an opaque token for ``sync_packed``.  State threads
+        through the launches, so later launches are ordered after these
+        device-side regardless of when anyone syncs."""
+        now_col = np.asarray(batch.now)
+        pending = np.argsort(now_col, kind="stable")
+        launched = []
+        for idx, slots, bw_w in self._build_waves(khash, pending):
+            glob = self._fill_glob(batch, idx, slots, bw_w)
+            packed, counters = self._launch_wave(glob, now_ms)
+            launched.append((idx, slots, packed, counters))
+        return (batch, khash, now_ms, launched)
+
+    def sync_packed(self, token, engine_lock=None) -> tuple:
+        """Pipeline phase 2: block on the launched waves and assemble
+        the response columns (same contract as check_packed).  Reading
+        launched outputs needs no lock (state isn't touched); the
+        table-full RETRY path re-enters check_packed, which mutates
+        state, so it runs under ``engine_lock`` when one is given.  A
+        retried row applies after any wave launched meanwhile —
+        acceptable: erred rows never mutated state, retries are the
+        table-full corner, and the device clamps per-key time
+        monotonically."""
+        batch, khash, now_ms, launched = token
+        n = len(khash)
+        status = np.zeros(n, np.int32)
+        rem_o = np.zeros(n, np.int64)
+        rst_o = np.zeros(n, np.int64)
+        lim_o = np.zeros(n, np.int64)
+        full = np.zeros(n, bool)
+        err_idx: List[int] = []
+        for idx, slots, packed, counters in launched:
+            o_st, o_rem, o_rst, o_lim, o_err = self._finish_wave(
+                packed, counters)
+            status[idx] = o_st[slots]
+            rem_o[idx] = o_rem[slots]
+            rst_o[idx] = o_rst[slots]
+            lim_o[idx] = o_lim[slots]
+            werr = o_err[slots]
+            if werr.any():
+                err_idx.extend(idx[werr].tolist())
+        if err_idx:
+            import contextlib
+
+            ei = np.asarray(sorted(err_idx))
+            sub = type(batch)(*[np.asarray(c)[ei] for c in batch])
+            with (engine_lock if engine_lock is not None
+                  else contextlib.nullcontext()):
+                r_st, r_lim, r_rem, r_rst, r_full = self.check_packed(
+                    sub, khash[ei], now_ms)
+            status[ei] = r_st
+            lim_o[ei] = r_lim
+            rem_o[ei] = r_rem
+            rst_o[ei] = r_rst
+            full[ei] = r_full
+        return status, lim_o, rem_o, rst_o, full
+
+    def warmup(self, now_ms: int = 1) -> None:
+        """Pre-compile every wave-bucket step program (all-invalid rows:
+        no state change).  Daemons call this before serving so a first
+        coalesced burst never eats a cold compile inside an RPC."""
+        for bw in self.wave_buckets:
+            self._run_wave(empty_batch(self.n * bw), now_ms)
+
+    def _launch_wave(self, glob: RequestBatch, now_ms: int):
+        """Dispatch one wave without blocking on its results: 2 uploads
+        + the step (async on the device stream; state threads through,
+        so later launches are ordered after this one device-side)."""
         a64, a32 = pack_wave_host(glob)
         d64 = jax.device_put(a64, self._mat_sharding)
         d32 = jax.device_put(a32, self._mat_sharding)
         self.state, packed, counters = self._step(
             self.state, d64, d32, np.int64(now_ms))
+        return packed, counters
+
+    def _finish_wave(self, packed, counters):
+        """Block on a launched wave's outputs (1 download) and fold its
+        counters.  Returns (status, remaining, reset, limit, table_full)
+        host arrays in [n·Bw] block order."""
         out = np.asarray(packed)
         self.over_count += int(counters[0])
         self.insert_count += int(counters[1])
         return out[0], out[1], out[2], out[3], out[4] != 0
+
+    def _run_wave(self, glob: RequestBatch, now_ms: int):
+        """One device launch over the packed wire layout: 2 uploads, the
+        step, 1 download.  Returns (status, remaining, reset, limit,
+        table_full) host arrays in [n·B] block order."""
+        return self._finish_wave(*self._launch_wave(glob, now_ms))
 
     def check_batch(self, reqs: Sequence[RateLimitRequest], now_ms: int
                     ) -> List[RateLimitResponse]:
@@ -423,24 +549,9 @@ class ShardedEngine:
         pending = np.argsort(now_col, kind="stable")
         retried = False
         while len(pending):
-            shard = shard_of(khash[pending], self.n)
-            order = np.argsort(shard, kind="stable")
-            s_sorted = shard[order]
-            # position within each shard's run → wave id + block slot.
-            # Stable sort keeps request order inside a shard, so same-key
-            # requests stay in original order (sequential parity).
-            starts = np.searchsorted(s_sorted, np.arange(self.n), "left")
-            posin = np.arange(len(pending)) - starts[s_sorted]
-            wave_id = posin // self.B
-            slot = s_sorted.astype(np.int64) * self.B + posin % self.B
             err_idx: List[int] = []
-            for w in range(int(wave_id.max()) + 1 if len(pending) else 0):
-                m = wave_id == w
-                idx = pending[order[m]]  # original indices
-                slots = slot[m]
-                glob = empty_batch(self.n * self.B)
-                for f in range(len(glob)):
-                    np.asarray(glob[f])[slots] = np.asarray(batch[f])[idx]
+            for idx, slots, bw_w in self._build_waves(khash, pending):
+                glob = self._fill_glob(batch, idx, slots, bw_w)
                 o_st, o_rem, o_rst, o_lim, o_err = self._run_wave(
                     glob, now_ms)
                 status[idx] = o_st[slots]

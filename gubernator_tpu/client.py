@@ -38,9 +38,14 @@ class Client:
 
     def get_rate_limits(self, reqs: Sequence[RateLimitRequest]
                         ) -> List[RateLimitResponse]:
+        from .tracing import outbound_metadata
+
         msg = pb.GetRateLimitsReq()
         msg.requests.extend(req_to_pb(r) for r in reqs)
-        resp = self._stub.GetRateLimits(msg, timeout=self.timeout_s)
+        # propagates the caller's W3C trace context when one is active
+        # (e.g. a service calling gubernator inside its own request)
+        resp = self._stub.GetRateLimits(msg, timeout=self.timeout_s,
+                                        metadata=outbound_metadata())
         return [resp_from_pb(m) for m in resp.responses]
 
     def check(self, req: RateLimitRequest) -> RateLimitResponse:

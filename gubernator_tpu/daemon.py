@@ -29,7 +29,7 @@ from .proto import gubernator_pb2 as pb
 from .proto import peers_pb2 as peers_pb
 from .store import FileLoader
 from .tlsutil import setup_tls
-from .tracing import span
+from .tracing import grpc_request_context, request_context, span
 from .types import Behavior, PeerInfo, RateLimitRequest
 from .wire import health_to_pb, req_from_pb, resp_to_pb
 
@@ -41,7 +41,8 @@ class _V1Servicer:
         self.instance = instance
 
     def GetRateLimits(self, request: pb.GetRateLimitsReq, context):
-        with span("grpc.GetRateLimits", metrics=self.instance.metrics):
+        with grpc_request_context(context), \
+                span("grpc.GetRateLimits", metrics=self.instance.metrics):
             try:
                 reqs = [req_from_pb(m) for m in request.requests]
                 resps = self.instance.get_rate_limits(reqs)
@@ -55,7 +56,8 @@ class _V1Servicer:
         """Raw-bytes twin of GetRateLimits (grpc_api.add_v1_servicer_raw):
         lets the instance's C++ wire lane run decode→decide→encode
         without pb2 when the batch qualifies."""
-        with span("grpc.GetRateLimits", metrics=self.instance.metrics):
+        with grpc_request_context(context), \
+                span("grpc.GetRateLimits", metrics=self.instance.metrics):
             try:
                 return self.instance.get_rate_limits_wire(request)
             except ValueError as e:
@@ -71,7 +73,9 @@ class _PeersServicer:
 
     def GetPeerRateLimits(self, request: peers_pb.GetPeerRateLimitsReq,
                           context):
-        with span("grpc.GetPeerRateLimits", metrics=self.instance.metrics):
+        with grpc_request_context(context), \
+                span("grpc.GetPeerRateLimits",
+                     metrics=self.instance.metrics):
             try:
                 reqs = [req_from_pb(m) for m in request.requests]
                 resps = self.instance.get_peer_rate_limits(reqs)
@@ -83,7 +87,9 @@ class _PeersServicer:
 
     def GetPeerRateLimitsWire(self, request: bytes, context):
         """Raw-bytes twin of GetPeerRateLimits (C++ wire lane)."""
-        with span("grpc.GetPeerRateLimits", metrics=self.instance.metrics):
+        with grpc_request_context(context), \
+                span("grpc.GetPeerRateLimits",
+                     metrics=self.instance.metrics):
             try:
                 return self.instance.get_peer_rate_limits_wire(request)
             except ValueError as e:
@@ -91,7 +97,9 @@ class _PeersServicer:
 
     def UpdatePeerGlobals(self, request: peers_pb.UpdatePeerGlobalsReq,
                           context):
-        with span("grpc.UpdatePeerGlobals", metrics=self.instance.metrics):
+        with grpc_request_context(context), \
+                span("grpc.UpdatePeerGlobals",
+                     metrics=self.instance.metrics):
             self.instance.update_peer_globals(list(request.globals))
             return peers_pb.UpdatePeerGlobalsResp()
 
@@ -177,6 +185,15 @@ class Daemon:
             self.instance.get_rate_limits(
                 [RateLimitRequest(name="_warmup", unique_key="w", hits=0,
                                   limit=1, duration=1000)])
+            import jax
+
+            if (hasattr(self.instance.engine, "warmup")
+                    and jax.default_backend() == "tpu"):
+                # every wave bucket, so a first coalesced burst never
+                # eats a minutes-scale cold compile inside an RPC.  Off
+                # TPU a bucket compiles in milliseconds on first use,
+                # not worth taxing every (test) daemon startup.
+                self.instance.engine.warmup()
             add_v1_servicer_raw(self.grpc_server,
                                 _V1Servicer(self.instance))
             add_peers_servicer_raw(self.grpc_server,
@@ -239,7 +256,8 @@ class Daemon:
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     reqs = [_json_to_req(o)
                             for o in payload.get("requests", [])]
-                    resps = daemon.instance.get_rate_limits(reqs)
+                    with request_context(self.headers.get("traceparent")):
+                        resps = daemon.instance.get_rate_limits(reqs)
                 except ValueError as e:
                     self._send(400, json.dumps({"error": str(e)}).encode())
                     return

@@ -20,6 +20,7 @@ import grpc
 from .config import BehaviorConfig
 from .grpc_api import PeersV1Stub, dial_peer
 from .proto import peers_pb2 as peers_pb
+from .tracing import outbound_metadata
 from .types import Behavior, PeerInfo, RateLimitRequest, RateLimitResponse
 from .wire import req_to_pb, resp_from_pb
 
@@ -44,7 +45,8 @@ class PeerClient:
         self._channel: Optional[grpc.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
         self._raw_peer_call = None  # bytes-in/bytes-out GetPeerRateLimits
-        self._queue: "queue.Queue[tuple[RateLimitRequest, Future]]" = queue.Queue()
+        #: (request, future, captured traceparent-or-None)
+        self._queue: "queue.Queue[tuple]" = queue.Queue()
         self._closing = threading.Event()
         self._lock = threading.Lock()
         self._flusher: Optional[threading.Thread] = None
@@ -76,27 +78,38 @@ class PeerClient:
         return fut.result(timeout=timeout_s)
 
     def enqueue(self, req: RateLimitRequest) -> Future:
-        """Queue one request for the next batch flush; resolve later."""
+        """Queue one request for the next batch flush; resolve later.
+
+        The caller's trace context is captured NOW (thread-local — the
+        flusher thread has none): the flush RPC carries the first
+        queued request's trace, best-effort continuity for batched
+        hops (a shared batch has no single parent by construction)."""
         if self._closing.is_set():
             raise ErrClosing("peer client is closing")
+        from .tracing import current_traceparent
+
         fut: Future = Future()
-        self._queue.put((req, fut))
+        self._queue.put((req, fut, current_traceparent()))
         self._start_flusher()
         return fut
 
     def get_peer_rate_limits(self, reqs: Sequence[RateLimitRequest],
-                             timeout_s: Optional[float] = None
+                             timeout_s: Optional[float] = None,
+                             traceparent: Optional[str] = None
                              ) -> List[RateLimitResponse]:
         """Synchronous batch call (peers.proto › GetPeerRateLimits).
         Default deadline is generous (forwarded checks must survive the
         owner's first-compile); the global manager passes its own
-        global_timeout_ms."""
+        global_timeout_ms.  ``traceparent`` lets the batch flusher carry
+        a trace captured at enqueue time (its own thread has none)."""
         stub = self._ensure_stub()
         msg = peers_pb.GetPeerRateLimitsReq()
         msg.requests.extend(req_to_pb(r) for r in reqs)
         if timeout_s is None:
             timeout_s = self.behaviors.batch_timeout_ms / 1000.0 + 60.0
-        resp = stub.GetPeerRateLimits(msg, timeout=timeout_s)
+        md = ([("traceparent", traceparent)] if traceparent
+              else outbound_metadata())
+        resp = stub.GetPeerRateLimits(msg, timeout=timeout_s, metadata=md)
         return [resp_from_pb(m) for m in resp.rate_limits]
 
     def get_peer_rate_limits_raw_future(self, data: bytes,
@@ -119,7 +132,8 @@ class PeerClient:
             call = self._raw_peer_call
         if timeout_s is None:
             timeout_s = self.behaviors.batch_timeout_ms / 1000.0 + 60.0
-        return call.future(data, timeout=timeout_s)
+        return call.future(data, timeout=timeout_s,
+                           metadata=outbound_metadata())
 
     def update_peer_globals(self, updates: Sequence[peers_pb.UpdatePeerGlobal]
                             ) -> None:
@@ -127,7 +141,8 @@ class PeerClient:
         msg = peers_pb.UpdatePeerGlobalsReq()
         msg.globals.extend(updates)
         stub.UpdatePeerGlobals(
-            msg, timeout=self.behaviors.global_timeout_ms / 1000.0)
+            msg, timeout=self.behaviors.global_timeout_ms / 1000.0,
+            metadata=outbound_metadata())
 
     # ---- batching loop -------------------------------------------------
 
@@ -158,18 +173,20 @@ class PeerClient:
             if batch:
                 self._flush(batch)
 
-    def _flush(self, batch: List[tuple[RateLimitRequest, Future]]) -> None:
+    def _flush(self, batch: List[tuple]) -> None:
         t0 = time.perf_counter()
         try:
-            resps = self.get_peer_rate_limits([r for r, _ in batch])
-            for (_, fut), resp in zip(batch, resps):
+            tp = next((t for _, _, t in batch if t), None)
+            resps = self.get_peer_rate_limits([r for r, _, _ in batch],
+                                              traceparent=tp)
+            for (_, fut, _), resp in zip(batch, resps):
                 fut.set_result(resp)
             missing = batch[len(resps):]
-            for _, fut in missing:
+            for _, fut, _ in missing:
                 fut.set_exception(
                     RuntimeError("peer returned short response batch"))
         except Exception as e:  # noqa: BLE001 - surfaced per-request
-            for _, fut in batch:
+            for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
         finally:
@@ -189,7 +206,7 @@ class PeerClient:
         # fail anything still queued
         while True:
             try:
-                _, fut = self._queue.get_nowait()
+                _, fut, _ = self._queue.get_nowait()
                 fut.set_exception(ErrClosing("peer client closed"))
             except queue.Empty:
                 break

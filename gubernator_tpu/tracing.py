@@ -30,6 +30,97 @@ except ImportError:
     _tracer = None
 
 
+# --- W3C trace-context propagation (traceparent in/out) ---------------
+#
+# The reference wires otelgrpc server/client interceptors (daemon.go),
+# which propagate the W3C `traceparent` header across hops.  The OTEL
+# SDK isn't required for that contract: the header format is a spec
+# ("00-<32hex trace-id>-<16hex parent-span-id>-<2hex flags>"), so we
+# parse/generate it natively and carry the active trace in a
+# thread-local — servicers adopt the inbound header, and every peer
+# call (pb2 or raw wire) re-emits it with a fresh span id.  When the
+# OTEL SDK is present the `span()` context manager still opens real
+# spans on top.
+
+import secrets
+import threading
+
+_tls = threading.local()
+
+#: Test/diagnostic hook: called with the RAW inbound traceparent header
+#: (or None) each time a request context is adopted.
+inbound_hook = None
+
+
+def parse_traceparent(header: Optional[str]):
+    """(trace_id_hex32, flags_hex2) or None for absent/malformed input
+    (malformed → start a new trace, per the W3C spec's restart rule)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or parts[0] != "00":
+        return None
+    tid, sid, flags = parts[1].lower(), parts[2].lower(), parts[3]
+    if len(tid) != 32 or len(sid) != 16 or len(flags) != 2 \
+            or tid == "0" * 32 or sid == "0" * 16:
+        return None
+    try:
+        int(tid, 16), int(sid, 16), int(flags, 16)
+    except ValueError:
+        return None
+    return tid, flags
+
+
+def current_traceparent() -> Optional[str]:
+    """Outbound header for the active request's trace (fresh span id
+    per hop), or None outside any request context."""
+    tp = getattr(_tls, "trace", None)
+    if tp is None:
+        return None
+    tid, flags = tp
+    return f"00-{tid}-{secrets.token_hex(8)}-{flags}"
+
+
+@contextlib.contextmanager
+def request_context(traceparent: Optional[str]) -> Iterator[None]:
+    """Adopt an inbound traceparent — or start a new trace — for the
+    handler's duration; peer calls made inside propagate the same
+    trace id (otelgrpc server-interceptor parity)."""
+    if inbound_hook is not None:
+        inbound_hook(traceparent)
+    parsed = parse_traceparent(traceparent)
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = parsed or (secrets.token_hex(16), "01")
+    try:
+        yield
+    finally:
+        _tls.trace = prev
+
+
+def grpc_request_context(context):
+    """request_context from a grpc servicer context's metadata."""
+    header = None
+    try:
+        for k, v in context.invocation_metadata():
+            if k.lower() == "traceparent":
+                header = v
+                break
+    except Exception:  # noqa: BLE001 - metadata is best-effort
+        pass
+    return request_context(header)
+
+
+def outbound_metadata(extra=()):
+    """grpc call metadata carrying the active trace (otelgrpc
+    client-interceptor parity); None when there is neither a trace nor
+    extra metadata."""
+    tp = current_traceparent()
+    md = list(extra)
+    if tp is not None:
+        md.append(("traceparent", tp))
+    return md or None
+
+
 @contextlib.contextmanager
 def span(name: str, metrics=None) -> Iterator[None]:
     """Host-side span: OTEL when available, always a duration metric —

@@ -1,0 +1,175 @@
+"""One-shot TPU validation battery for when the axon tunnel is alive.
+
+The tunnel wedges permanently if a client abandons an in-flight compile
+(ROUND_NOTES round 1), so when a chip IS reachable every open question
+must be answered in ONE session window, cheapest first.  This script
+runs that battery and writes /tmp/tpu_session.json as it goes (each
+stage's result lands immediately, so a later wedge loses nothing):
+
+  1. trivial-op probe (is the tunnel alive at all?)
+  2. step-mode duel at serving shapes: copy vs donated decide_batch at
+     CAP 2^21 (answers PERF.md §5.1 — does the TPU lowering update
+     in place, or serialize aliased scatters?)
+  3. capacity sweep in the winning mode: CAP 2^21 → 2^24 (is the
+     streaming wall broken — cost ~flat — or still linear?)
+  4. config-5 probe: one donated step at CAP 2^27 (does the 100M-key
+     table fit and run?)
+  5. scan superstep (on-chip rate, launch latency excluded)
+  6. full bench.py inner run (the driver-shaped JSON, both modes)
+
+Usage (give it a LONG timeout — cold compiles took 444s in round 1;
+never ctrl-C an in-flight stage):
+
+    timeout 5400 python tools/tpu_session.py
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/gubernator_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+OUT = "/tmp/tpu_session.json"
+results: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
+
+
+def record(key, value):
+    results[key] = value
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"[tpu_session] {key}: {value}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.time()
+    backend = jax.default_backend()
+    x = int(jnp.arange(8).sum())
+    record("probe", {"backend": backend, "sum": x,
+                     "seconds": round(time.time() - t0, 1)})
+    if backend != "tpu":
+        record("abort", f"backend is {backend}, not tpu")
+        return 1
+
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.core.step import decide_batch, decide_batch_donated
+    from gubernator_tpu.core.table import init_table
+
+    # share the bench's key distribution + populate padding, so these
+    # answers apply verbatim to the driver's bench run
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from bench import _keyhash as keyhash, pad_chunk
+
+    i64 = jnp.int64
+    B = int(os.environ.get("GUBER_BENCH_B", 65536))
+    rng = np.random.default_rng(42)
+
+    def mk(keys):
+        n = keys.shape[0]
+        return RequestBatch(
+            key=jnp.asarray(keys), hits=jnp.ones(n, i64),
+            limit=jnp.full(n, 100, i64), duration=jnp.full(n, 10_000, i64),
+            eff_ms=jnp.full(n, 10_000, i64), greg_end=jnp.zeros(n, i64),
+            behavior=jnp.zeros(n, jnp.int32),
+            algorithm=jnp.zeros(n, jnp.int32),
+            burst=jnp.full(n, 100, i64), valid=jnp.ones(n, bool))
+
+    NOW = 1_760_000_000_000
+
+    def measure(step_fn, cap, n_keys, label, reps=64):
+        st = init_table(cap)
+        batches = [mk(keyhash((rng.zipf(1.1, size=B) % n_keys)
+                              .astype(np.uint64))) for _ in range(4)]
+        t = time.time()
+        st, out = step_fn(st, batches[0], jnp.asarray(NOW, i64))
+        out.status.block_until_ready()
+        compile_s = round(time.time() - t, 1)
+        # populate (same padding policy as bench.populate)
+        ids = np.arange(n_keys, dtype=np.uint64)
+        for a in range(0, n_keys, B):
+            ch = pad_chunk(ids[a:a + B], B)
+            st, out = step_fn(st, mk(keyhash(ch)), jnp.asarray(NOW, i64))
+        out.status.block_until_ready()
+        t = time.time()
+        for r in range(reps):
+            st, out = step_fn(st, batches[r % 4],
+                              jnp.asarray(NOW + 1 + r, i64))
+        out.status.block_until_ready()
+        dt = time.time() - t
+        rate = reps * B / dt
+        record(label, {"decisions_per_s": round(rate),
+                       "ms_per_step": round(dt / reps * 1e3, 3),
+                       "compile_s": compile_s, "cap": cap,
+                       "n_keys": n_keys, "B": B})
+        return rate
+
+    # 2. step-mode duel at CAP 2^21 (1M keys)
+    r_copy = measure(decide_batch, 1 << 21, 1_000_000, "copy_cap21")
+    r_don = measure(decide_batch_donated, 1 << 21, 1_000_000,
+                    "donate_cap21")
+    winner = decide_batch_donated if r_don > r_copy else decide_batch
+    record("step_mode", "donate" if r_don > r_copy else "copy")
+
+    # 3. capacity sweep in the winning mode (is cost flat in CAP?)
+    measure(winner, 1 << 22, 2_000_000, "win_cap22")
+    measure(winner, 1 << 24, 10_000_000, "win_cap24")
+
+    # 4. config-5 probe: CAP 2^27 fits only donated (one table copy)
+    try:
+        st5 = init_table(1 << 27)
+        k5 = mk(keyhash(rng.integers(0, 100_000_000, size=B)
+                        .astype(np.uint64)))
+        t = time.time()
+        st5, out = decide_batch_donated(st5, k5, jnp.asarray(NOW, i64))
+        out.status.block_until_ready()
+        first = time.time() - t
+        t = time.time()
+        for r in range(8):
+            st5, out = decide_batch_donated(st5, k5,
+                                            jnp.asarray(NOW + r, i64))
+        out.status.block_until_ready()
+        record("cap27_probe", {
+            "ok": True, "first_step_s": round(first, 1),
+            "decisions_per_s": round(8 * B / (time.time() - t))})
+        del st5
+    except Exception as e:  # noqa: BLE001
+        record("cap27_probe", {"ok": False, "error": str(e)[:300]})
+
+    # 5+6. the full driver-shaped bench (scan superstep, latency,
+    # secondary configs, clustered service) in this same window.
+    # Never SIGKILL it mid-compile (that's the tunnel-wedge mechanism):
+    # the inner timeout is generous and expiry is RECORDED, not fatal —
+    # stages 1–4 above already answered the load-bearing questions.
+    os.environ["GUBER_BENCH_INNER"] = "1"
+    import subprocess
+
+    bench_timeout = int(os.environ.get("GUBER_SESSION_BENCH_TIMEOUT",
+                                       "5400"))
+    try:
+        r = subprocess.run([sys.executable,
+                            os.path.join(os.path.dirname(__file__), "..",
+                                         "bench.py")],
+                           stdout=subprocess.PIPE, timeout=bench_timeout)
+        line = (r.stdout or b"").decode().strip().splitlines()
+        record("bench", json.loads(line[-1]) if line and
+               line[-1].startswith("{") else {"error": "no JSON line"})
+    except subprocess.TimeoutExpired as e:
+        partial = (e.stdout or b"").decode(errors="replace")[-1000:]
+        record("bench", {"error": f"timed out after {bench_timeout}s "
+                                  "(tunnel may now be wedged — probe "
+                                  "before any further TPU work)",
+                         "partial_stdout": partial})
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # noqa: BLE001
+        record("fatal", str(e)[:400])
+        raise

@@ -431,6 +431,19 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
                                           now_ms=NOW0 + 101 + r)
             out["6_service_path"]["wire_lane_decisions_per_s"] = round(
                 reps * 1000 / (time.perf_counter() - t0))
+            # service-layer latency at the client-batch shape (the
+            # p99 < 2 ms target's request): bytes → decisions → bytes
+            # through the full V1Instance wire lane
+            lat = []
+            for r in range(60):
+                t0 = time.perf_counter()
+                inst.get_rate_limits_wire(datas[r % 4],
+                                          now_ms=NOW0 + 130 + r)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            out["6_service_path"]["svc_p50_ms"] = round(
+                float(np.percentile(lat, 50)), 3)
+            out["6_service_path"]["svc_p99_ms"] = round(
+                float(np.percentile(lat, 99)), 3)
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["wire_lane_error"] = str(e)[:200]
         # concurrent front door: 16 caller threads through the full

@@ -99,3 +99,48 @@ def test_join_without_handover_resets_moved_rows(mesh):
         d1.close()
         if d2 is not None:
             d2.close()
+
+
+def test_handover_preserves_30day_leaky_fixed_point(mesh):
+    """Cross-feature: int64-duration leaky rows survive a handover
+    losslessly.  A 30-day leaky bucket's remaining is td fixed point
+    (remaining × eff, eff ≈ 2.59e9 ms > the old 2^31-1 clamp); the
+    transfer sends the RAW value with eff_ms, so the new owner must
+    answer with the exact same floor remaining."""
+    from gubernator_tpu.types import Algorithm
+
+    MONTH = 30 * 86_400_000
+
+    def lreq(i, hits=1):
+        return RateLimitRequest(
+            name="ho64", unique_key=f"m{i}", hits=hits, limit=30,
+            duration=MONTH, algorithm=Algorithm.LEAKY_BUCKET, burst=12)
+
+    d1 = mk_daemon(mesh)
+    d2 = None
+    try:
+        with Client(f"127.0.0.1:{d1.grpc_port}") as c:
+            rs = c.get_rate_limits([lreq(i, hits=5) for i in range(N_KEYS)])
+            assert all(r.error == "" for r in rs)
+            # burst 12, 5 consumed → remaining floor 7 (leak over test
+            # runtime is ~1 token/day: invisible)
+            assert {r.remaining for r in rs} == {7}, \
+                {r.remaining for r in rs}
+        d2 = mk_daemon(mesh)
+        infos = [d1.peer_info(), d2.peer_info()]
+        d1.set_peers(infos)
+        d2.set_peers(infos)
+        deadline = time.time() + 30
+        vals = []
+        while time.time() < deadline:
+            with Client(f"127.0.0.1:{d1.grpc_port}") as c:
+                vals = [c.get_rate_limits([lreq(i, hits=0)])[0].remaining
+                        for i in range(N_KEYS)]
+            if all(v == 7 for v in vals):
+                break
+            time.sleep(0.2)
+        assert all(v == 7 for v in vals), vals
+    finally:
+        d1.close()
+        if d2 is not None:
+            d2.close()

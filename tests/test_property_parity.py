@@ -120,3 +120,49 @@ def test_merged_cross_time_batch_matches_sequential_oracle(jobs):
                 == (int(w.status), w.remaining, w.reset_time, w.limit), \
                 (g, i, reqs[i])
             g += 1
+
+
+_i64_request = st.builds(
+    RateLimitRequest,
+    name=st.just("prop64"),
+    unique_key=st.integers(0, 7).map(lambda i: f"w{i}"),  # forced dups
+    hits=st.integers(0, 2**40),
+    limit=st.integers(0, 2**50),
+    # spans the interesting clamp boundaries: FRAC_SAFE (2^31),
+    # EFF_MAX (2^35), DURATION_MAX (2^53) and beyond
+    duration=st.one_of(
+        st.integers(1, 10**6),
+        st.integers(2**31 - 10, 2**31 + 10),
+        st.integers(2**35 - 10, 2**35 + 10),
+        st.integers(2**40, 2**60)),
+    algorithm=st.sampled_from([Algorithm.TOKEN_BUCKET,
+                               Algorithm.LEAKY_BUCKET]),
+    behavior=_behavior,
+    burst=st.integers(0, 2**45),
+)
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(
+    st.tuples(st.lists(_i64_request, min_size=1, max_size=24),
+              st.integers(0, 2**36)),  # time jumps past leaky windows
+    min_size=1, max_size=4))
+def test_engine_matches_oracle_on_int64_ranges(stream):
+    """The round-2 int64 clamp contract (DURATION_MAX/EFF_MAX/TD_BOUND
+    + the rescale/replenish guards) must hold bit-for-bit for ANY
+    stream mixing calendar-scale durations, clamp-boundary values, and
+    duration changes on live keys."""
+    eng = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 10,
+                        batch_per_shard=64)
+    oracle = Oracle()
+    now = NOW
+    for reqs, dt in stream:
+        now += dt
+        want = oracle.check_batch(reqs, now)
+        got = eng.check_batch(reqs, now)
+        for i, (w, g) in enumerate(zip(want, got)):
+            assert g.error == ""
+            assert (int(g.status), g.remaining, g.reset_time, g.limit) == \
+                (int(w.status), w.remaining, w.reset_time, w.limit), \
+                (i, reqs[i])

@@ -290,9 +290,15 @@ class ShardedEngine:
         #: ceil(n/B) small ones (the front-door throughput lever —
         #: VERDICT r1 item 5).  Each bucket is one compiled program;
         #: warmup() pre-compiles them all.
-        self.wave_buckets = (tuple(sorted(set(wave_buckets)))
-                             if wave_buckets
-                             else (batch_per_shard, batch_per_shard * 8))
+        import os as _os
+        env_buckets = _os.environ.get("GUBER_WAVE_BUCKETS", "")
+        if wave_buckets:
+            self.wave_buckets = tuple(sorted(set(wave_buckets)))
+        elif env_buckets:
+            self.wave_buckets = tuple(sorted(
+                {int(x) for x in env_buckets.split(",") if x.strip()}))
+        else:
+            self.wave_buckets = (batch_per_shard, batch_per_shard * 8)
         #: per-shard capacity ceiling for on-device auto-grow when probe
         #: windows stay exhausted after a sweep (0 = disabled).  The
         #: reference's LRU never fails an insert; with auto-grow on,
@@ -304,7 +310,6 @@ class ShardedEngine:
         # core/step.py › decide_batch_donated).  Off by default until
         # the backend's in-place scatter lowering is measured fast
         # (bench.py records both modes).
-        import os as _os
         self._step = make_sharded_step_packed(
             self.mesh,
             donate=_os.environ.get("GUBER_STEP_DONATE", "0") == "1")

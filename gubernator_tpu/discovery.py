@@ -534,8 +534,11 @@ class EtcdDiscovery(Discovery):
 class K8sDiscovery(Discovery):
     """kubernetes.go › K8sPool analog over the raw API server (no
     client library): reads the in-cluster service-account token + CA,
-    polls Endpoints (by service name) or Pods (by label selector) and
-    maps addresses to peers at ``grpc_port``."""
+    watches Endpoints (by service name) or Pods (by label selector) —
+    `?watch=1` streaming, the raw form of client-go informers — and
+    maps addresses to peers at ``grpc_port``.  The interval poll stays
+    as the resilience backstop (watch reconnects, missed events), same
+    structure as EtcdDiscovery's watch."""
 
     SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -543,7 +546,7 @@ class K8sDiscovery(Discovery):
                  grpc_port: int, service: str = "", api_base: str = "",
                  token: str = "", ca_file: str = "",
                  insecure_skip_verify: bool = False,
-                 poll_interval_ms: int = 15_000):
+                 poll_interval_ms: int = 15_000, watch: bool = True):
         super().__init__(on_change)
         self.grpc_port = grpc_port
         self.namespace = namespace or self._read(f"{self.SA_DIR}/namespace",
@@ -580,9 +583,21 @@ class K8sDiscovery(Discovery):
                 "k8s discovery: HTTPS API server but no CA cert found; "
                 "provide ca_file or set GUBER_K8S_INSECURE=true "
                 "(insecure_skip_verify) explicitly")
+        self._poll_mu = threading.Lock()  # watch vs interval ordering
+        #: list resourceVersion: watches resume FROM it, so reconnects
+        #: replay nothing (the informer pattern — without it the API
+        #: server would re-send synthetic ADDED events for every object
+        #: on each reconnect, each triggering a full relist)
+        self._rv: Optional[str] = None
         self._poll()
         self._loop = IntervalLoop(poll_interval_ms, self._poll,
                                   name="k8s-discovery")
+        self._watch_stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+        if watch:
+            self._watcher = threading.Thread(
+                target=self._watch_loop, daemon=True, name="k8s-watch")
+            self._watcher.start()
 
     @staticmethod
     def _read(path: str, default: str) -> str:
@@ -592,22 +607,93 @@ class K8sDiscovery(Discovery):
         except OSError:
             return default
 
-    def _get(self, path: str) -> dict:
+    def _ssl_ctx(self):
         import ssl as _ssl
-        import urllib.request
 
-        ctx = _ssl.create_default_context(
-            cafile=self.ca_file or None)
+        ctx = _ssl.create_default_context(cafile=self.ca_file or None)
         if not self.ca_file and self.insecure:
             ctx.check_hostname = False
             ctx.verify_mode = _ssl.CERT_NONE
+        return ctx
+
+    def _request(self, path: str):
+        import urllib.request
+
         req = urllib.request.Request(self.api_base + path)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        with urllib.request.urlopen(req, timeout=10, context=ctx) as f:
+        return req
+
+    def _get(self, path: str) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(self._request(path), timeout=10,
+                                    context=self._ssl_ctx()) as f:
             return json.loads(f.read())
 
+    def _watch_path(self) -> str:
+        from urllib.parse import quote
+
+        if self.selector:
+            base = (f"/api/v1/namespaces/{self.namespace}/pods"
+                    f"?labelSelector={quote(self.selector)}&watch=1")
+        else:
+            base = (f"/api/v1/namespaces/{self.namespace}/endpoints"
+                    f"?fieldSelector=metadata.name%3D{quote(self.service)}"
+                    "&watch=1")
+        # server-side timeout keeps idle streams cycling gracefully
+        # (bounded, no client-side read-timeout churn); resuming from
+        # the last list's resourceVersion means a reconnect replays
+        # nothing
+        base += "&timeoutSeconds=300&allowWatchBookmarks=true"
+        if self._rv:
+            base += f"&resourceVersion={quote(str(self._rv))}"
+        return base
+
+    def _watch_loop(self) -> None:
+        """Long-lived `?watch=1` stream (newline-delimited JSON events);
+        a real event triggers an authoritative re-poll (which also
+        refreshes the resume resourceVersion) — serialized against the
+        interval poll via _poll_mu.  BOOKMARK events only advance the
+        resume point; ERROR (e.g. 410 Gone: the version expired) drops
+        it so the next connect starts from a fresh list."""
+        import urllib.request
+
+        while not self._watch_stop.is_set():
+            try:
+                req = self._request(self._watch_path())
+                with urllib.request.urlopen(req, timeout=330,
+                                            context=self._ssl_ctx()) as f:
+                    while not self._watch_stop.is_set():
+                        line = f.readline()
+                        if not line:
+                            break  # stream closed: reconnect
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        kind = ev.get("type")
+                        if kind == "ERROR":
+                            self._rv = None
+                            break
+                        if kind == "BOOKMARK":
+                            rv = ((ev.get("object") or {})
+                                  .get("metadata", {})
+                                  .get("resourceVersion"))
+                            if rv:
+                                self._rv = rv
+                            continue
+                        if kind and not self._watch_stop.is_set():
+                            self._poll()
+            except Exception:  # noqa: BLE001 - reconnect below
+                pass
+            self._watch_stop.wait(1.0)  # back off before reconnecting
+
     def _poll(self) -> None:
+        with self._poll_mu:
+            self._poll_locked()
+
+    def _poll_locked(self) -> None:
         from urllib.parse import quote
 
         try:
@@ -631,6 +717,10 @@ class K8sDiscovery(Discovery):
         except Exception as e:  # noqa: BLE001 - keep last membership
             log.warning("k8s discovery poll: %s", e)
             return
+        # refresh the watch resume point from the authoritative list
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        if rv:
+            self._rv = rv
         # an empty SUCCESSFUL result is real membership (all pods
         # unready): notify it so the instance falls back to local-only
         # instead of forwarding to dead addresses
@@ -639,7 +729,11 @@ class K8sDiscovery(Discovery):
 
     def close(self) -> None:
         self.mark_closed()
+        self._watch_stop.set()
         self._loop.close()
+        if self._watcher is not None:
+            # daemon thread; may be mid-blocking-read — don't linger
+            self._watcher.join(timeout=0.2)
 
 
 def make_discovery(cfg: DaemonConfig, self_info: PeerInfo,

@@ -237,13 +237,17 @@ def test_etcd_range_end_edge_cases():
 
 
 class FakeK8s:
-    """Minimal API server: /api/v1/namespaces/{ns}/pods and /endpoints."""
+    """Minimal API server: /api/v1/namespaces/{ns}/pods and /endpoints,
+    plus `?watch=1` streaming (newline-delimited watch events, as the
+    real API server emits them)."""
 
     def __init__(self, pods=None, endpoints=None):
         fake = self
         self.pods = pods or []
         self.endpoints = endpoints or []
         self.auth_seen = []
+        self.watchers = []
+        self.watch_mu = threading.Lock()
 
         class H(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -253,6 +257,9 @@ class FakeK8s:
                 fake.auth_seen.append(self.headers.get("Authorization", ""))
                 fake.paths = getattr(fake, "paths", [])
                 fake.paths.append(self.path)
+                if "watch=1" in self.path:
+                    fake.serve_watch(self)
+                    return
                 if "/pods" in self.path:
                     out = {"items": fake.pods}
                 else:
@@ -269,7 +276,37 @@ class FakeK8s:
         threading.Thread(target=self.server.serve_forever,
                          daemon=True).start()
 
+    def serve_watch(self, handler):
+        import queue
+
+        q = queue.Queue()
+        with self.watch_mu:
+            self.watchers.append(q)
+        try:
+            handler.send_response(200)
+            handler.end_headers()
+            while True:
+                ev = q.get(timeout=60)
+                if ev is None:
+                    return
+                handler.wfile.write(json.dumps(ev).encode() + b"\n")
+                handler.wfile.flush()
+        except Exception:  # noqa: BLE001 - client went away / shutdown
+            pass
+        finally:
+            with self.watch_mu:
+                if q in self.watchers:
+                    self.watchers.remove(q)
+
+    def emit(self, ev_type, obj=None):
+        with self.watch_mu:
+            for q in self.watchers:
+                q.put({"type": ev_type, "object": obj or {}})
+
     def close(self):
+        with self.watch_mu:
+            for q in self.watchers:
+                q.put(None)
         self.server.shutdown()
         self.server.server_close()
 
@@ -310,7 +347,47 @@ def test_k8s_named_endpoints_mode():
         assert {p.grpc_address for p in got[-1]} == {
             "10.2.0.1:1051", "10.2.0.2:1051"}
         # must target the NAMED Endpoints object, not the namespace list
-        assert fake.paths[-1].endswith("/endpoints/gubernator-tpu-peers")
+        # (paths[-1] may be the concurrent watch request)
+        assert any(p.endswith("/endpoints/gubernator-tpu-peers")
+                   for p in fake.paths)
+        d.close()
+    finally:
+        fake.close()
+
+
+def test_k8s_watch_driven_membership():
+    """Pod churn must arrive through the `?watch=1` stream: with a
+    60-second poll interval, only watch events can explain sub-second
+    convergence (the raw form of client-go informers)."""
+    fake = FakeK8s(pods=[
+        {"status": {"podIP": "10.3.0.1", "phase": "Running"}}])
+    got = []
+    try:
+        d = K8sDiscovery(got.append, "default", "app=gub", 1051,
+                         api_base=fake.url, token="t",
+                         poll_interval_ms=60_000)
+        assert [p.grpc_address for p in got[-1]] == ["10.3.0.1:1051"]
+        deadline = time.time() + 5
+        while time.time() < deadline and not fake.watchers:
+            time.sleep(0.05)
+        assert fake.watchers, "watch stream never attached"
+        # a new pod starts; the API server streams an ADDED event
+        fake.pods.append({"status": {"podIP": "10.3.0.2",
+                                     "phase": "Running"}})
+        fake.emit("ADDED")
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got[-1]) != 2:
+            time.sleep(0.05)
+        assert {p.grpc_address for p in got[-1]} == {
+            "10.3.0.1:1051", "10.3.0.2:1051"}, \
+            "watch event did not drive membership"
+        # pod deletion propagates the same way
+        fake.pods.pop(0)
+        fake.emit("DELETED")
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got[-1]) != 1:
+            time.sleep(0.05)
+        assert [p.grpc_address for p in got[-1]] == ["10.3.0.2:1051"]
         d.close()
     finally:
         fake.close()

@@ -290,15 +290,17 @@ class GossipDiscovery(Discovery):
                 # direct evidence: refresh (or meet) the sender
                 prev = self._members.get(sender)
                 first_contact = prev is None
-                info = (members.get(sender)
-                        or (prev[0] if prev else None))
+                info = members.get(sender)
+                if not isinstance(info, dict):
+                    info = prev[0] if prev else None
                 if info is not None:
                     self._members[sender] = (info, now)
-                elif prev is not None:
-                    self._members[sender] = (prev[0], now)
             # hearsay only INTRODUCES members, never refreshes them
+            # (and only well-formed entries: a null/garbage info dict
+            # stored here would crash every later tick's notify)
             for addr, info in members.items():
-                if isinstance(addr, str) and addr != self.gossip_addr \
+                if isinstance(addr, str) and isinstance(info, dict) \
+                        and addr != self.gossip_addr \
                         and addr != sender and addr not in self._members:
                     self._members[addr] = (info, now)
         if kind == "ping" and sender:

@@ -182,3 +182,44 @@ class TestGossipHardening:
         finally:
             for node in nodes:
                 node.close()
+
+
+class TestMalformedDatagrams:
+    def test_rx_survives_garbage(self):
+        """Unauthenticated UDP: junk datagrams (bad JSON, wrong types,
+        non-dict payloads) must neither kill the rx thread nor perturb
+        membership."""
+        import socket as _socket
+
+        nodes, recs = spawn(2)
+        try:
+            two = ALL3[:2]
+            assert wait_until(
+                lambda: all(r.latest() == two for r in recs), 15)
+            s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            host, _, port = nodes[0].gossip_addr.rpartition(":")
+            tgt = (host, int(port))
+            for payload in (b"\xff\x00garbage", b"[1,2,3]", b'"str"',
+                            b'{"t":"ping-req","from":"x","target":123}',
+                            b'{"t":"ping","from":42}',
+                            b'{"from":"x:1","members":[1,2]}',
+                            # well-formed JSON, poisonous values: a null
+                            # info must not enter the member map (it
+                            # would crash every later notify) and a
+                            # non-dict sender entry must not be stored
+                            b'{"members":{"1.2.3.4:9":null}}',
+                            b'{"from":"9.9.9.9:1","members":'
+                            b'{"9.9.9.9:1":"notadict"}}'):
+                s.sendto(payload, tgt)
+            s.close()
+            time.sleep(1.0)
+            # rx thread alive and membership still exact
+            assert nodes[0]._rx.is_alive()
+            assert recs[0].latest() == two, recs[0].latest()
+            # and the node still processes real traffic afterwards
+            t0 = time.monotonic()
+            assert wait_until(
+                lambda: all(r.latest() == two for r in recs), 5)
+        finally:
+            for node in nodes:
+                node.close()

@@ -22,7 +22,8 @@ import grpc
 
 from .config import DaemonConfig
 from .discovery import make_discovery
-from .grpc_api import add_peers_servicer_raw, add_v1_servicer_raw
+from .grpc_api import (add_health_servicer, add_peers_servicer_raw,
+                       add_v1_servicer_raw)
 from .instance import V1Instance
 from .netutil import resolve_host_ip, split_host_port
 from .proto import gubernator_pb2 as pb
@@ -198,6 +199,7 @@ class Daemon:
                                 _V1Servicer(self.instance))
             add_peers_servicer_raw(self.grpc_server,
                                    _PeersServicer(self.instance))
+            add_health_servicer(self.grpc_server, self.instance)
             self.grpc_server.start()
 
             if cfg.http_listen_address:

@@ -89,6 +89,29 @@ def add_peers_servicer_raw(server: grpc.Server, servicer) -> None:
         (grpc.method_handlers_generic_handler(PEERS_SERVICE, handlers),))
 
 
+def add_health_servicer(server: grpc.Server, instance) -> None:
+    """Standard ``grpc.health.v1.Health/Check`` — what Kubernetes gRPC
+    probes and ``grpc_health_probe`` speak (the reference daemon
+    registers the stock health server alongside its own HealthCheck
+    RPC).  Hand-rolled wire, matching the rest of this module: the
+    request (field 1: service name) is accepted for any service and
+    answered with the daemon's overall health; the response is field 1
+    varint ServingStatus (1 = SERVING, 2 = NOT_SERVING).  The streaming
+    ``Watch`` method is not served (probes poll ``Check``)."""
+
+    def check(request: bytes, context):
+        ok = instance.health_check().status == "healthy"
+        return bytes([0x08, 0x01 if ok else 0x02])
+
+    handlers = {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            check, request_deserializer=None, response_serializer=None),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler("grpc.health.v1.Health",
+                                              handlers),))
+
+
 class V1Stub:
     """Client stub for the V1 service (generated-code equivalent)."""
 

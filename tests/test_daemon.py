@@ -182,3 +182,27 @@ def test_unknown_discovery_type():
 
     with pytest.raises(ValueError):
         make_discovery(cfg, PeerInfo(grpc_address="x:1"), lambda p: None)
+
+
+def test_standard_grpc_health_protocol(mesh):
+    """grpc.health.v1.Health/Check — what k8s gRPC probes and
+    grpc_health_probe speak — must answer SERVING on a healthy
+    daemon.  Wire: response field 1 varint ServingStatus."""
+    import grpc as _grpc
+
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.netutil import free_port
+
+    d = spawn_daemon(DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address="", cache_size=1 << 10), mesh=mesh)
+    try:
+        ch = _grpc.insecure_channel(f"127.0.0.1:{d.grpc_port}")
+        call = ch.unary_unary("/grpc.health.v1.Health/Check")
+        # empty request (overall health) and a named service both serve
+        assert call(b"", timeout=30) == b"\x08\x01"
+        assert call(b"\x0a\x10pb.gubernator.V1", timeout=30) == b"\x08\x01"
+        ch.close()
+    finally:
+        d.close()

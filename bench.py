@@ -629,7 +629,38 @@ def _watchdog_main():
             log(f"bench attempt failed: {e2!r}")
         return None
 
-    out = attempt({}, deadline)
+    def device_probe(timeout=150) -> bool:
+        """Trivial-op probe in a throwaway subprocess: the axon tunnel
+        has repeatedly been observed wedged such that backend init
+        hangs forever — don't spend the full deadline discovering
+        that.  (150 s covers a healthy cold init + trivial compile many
+        times over; this mirrors the probe protocol in ROUND_NOTES.)
+        A probe that answers with the CPU backend is a FAILED device
+        probe: jax fell back silently, and running the device-sized
+        workload there would burn the deadline and mislabel the rows."""
+        code = ("import jax, jax.numpy as jnp;"
+                "jnp.arange(8).sum().block_until_ready();"
+                "print(jax.default_backend())")
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               timeout=timeout, stdout=subprocess.PIPE,
+                               stderr=subprocess.PIPE)
+            backend = (r.stdout or b"").decode().strip()
+            ok = r.returncode == 0 and backend not in ("", "cpu")
+            log(f"device probe: ok={ok} backend={backend!r}")
+            if r.returncode != 0:
+                tail = (r.stderr or b"").decode(errors="replace")[-400:]
+                log(f"device probe stderr tail: {tail}")
+            return ok
+        except Exception as e2:  # noqa: BLE001
+            log(f"device probe failed: {e2!r:.120} (tunnel wedged?)")
+            return False
+
+    if os.environ.get("GUBER_JAX_PLATFORM", "") == "cpu" or device_probe():
+        out = attempt({}, deadline)
+    else:
+        log("skipping the device attempt: backend unreachable")
+        out = None
     if out is None and os.environ.get("GUBER_JAX_PLATFORM", "") != "cpu":
         log("falling back to CPU (device backend unreachable or hung)")
         out = attempt({"GUBER_JAX_PLATFORM": "cpu",

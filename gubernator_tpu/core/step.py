@@ -512,14 +512,15 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     )
 
 
-#: Host-dispatch entry point.
+#: Host-dispatch entry point WITHOUT buffer donation — test/debug use.
 #:
-#: Does NOT donate the table buffers: without aliasing the row scatters
-#: fuse into one dense streaming copy of the table (bandwidth-bound,
-#: ~2 × CAP × row-bytes per launch, independent of B) — the safe
-#: default on every backend.  Round 1 measured one lowering where
-#: donated in-place scatters serialized (~4 µs/row — 16 ms/batch at
-#: B=4096), so donation is opt-in via ``decide_batch_donated``.
+#: Serving uses the donated variant below (and has since the v5e
+#: measurement of 2026-07-31, PERF.md §5.1): on that lowering the
+#: NON-donated row scatters serialize at ~3 µs/row — 209 ms/batch at
+#: B=65536, 365× slower than donated — and donation also wins 6.3× on
+#: CPU.  Copy mode survives only for callers that cannot thread state
+#: linearly (tests asserting on both old and new tables, lowerings
+#: without aliasing support).
 decide_batch = jax.jit(decide_batch_impl)
 
 #: Donated variant: the table aliases in/out, so the cond-gated cold

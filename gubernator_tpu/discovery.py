@@ -173,6 +173,14 @@ class GossipDiscovery(Discovery):
       it, so a joiner converges in one round trip instead of waiting
       out heartbeat intervals (memberlist's push/pull state sync,
       minus TCP).
+    - **Dead-member rejoin probes (anti-entropy across a healed
+      partition).** Evicted members are retained in a dead list for
+      ``dead_retain_ms``; each tick one random dead address also gets
+      the heartbeat.  Without this, a full partition longer than
+      ``dead_ms`` is permanent: both halves evict each other, neither
+      heartbeats the other again, and only a static seed spanning the
+      cut could ever re-merge them (memberlist's dead-node reconnect
+      behavior).
 
     Full-mesh heartbeats (not SWIM's random sampling) — fine for the
     tens-of-nodes clusters the reference targets.
@@ -181,7 +189,8 @@ class GossipDiscovery(Discovery):
     def __init__(self, on_change: OnChange, bind: str, self_info: PeerInfo,
                  known_hosts: Sequence[str], interval_ms: int = 1000,
                  suspect_ms: int = 5000, dead_ms: Optional[int] = None,
-                 indirect_probes: int = 3):
+                 indirect_probes: int = 3,
+                 dead_retain_ms: Optional[int] = None):
         super().__init__(on_change)
         self.self_info = self_info
         host, _, port = bind.rpartition(":")
@@ -196,6 +205,13 @@ class GossipDiscovery(Discovery):
         #: gossip_addr → (PeerInfo dict, last_seen monotonic); guarded by
         #: _members_mu (written by the rx thread, read by the tx tick).
         self._members: dict = {}
+        #: gossip_addr → eviction monotonic time: rejoin-probe targets
+        #: (same lock).  Bounded by dead_retain_s so a long-gone address
+        #: doesn't collect datagrams forever.
+        self._dead: dict = {}
+        self.dead_retain_s = (dead_retain_ms / 1000.0
+                              if dead_retain_ms is not None
+                              else 30 * self.dead_s)
         self._members_mu = threading.Lock()
         self._seeds = list(known_hosts)
         self._stop = threading.Event()
@@ -237,7 +253,12 @@ class GossipDiscovery(Discovery):
                         if now - seen > self.suspect_s]
             alive = [a for a, (_, seen) in self._members.items()
                      if now - seen <= self.suspect_s]
-        for t in set(self._seeds) | set(known):
+            dead_pool = [a for a in self._dead if a not in self._members]
+        # one random rejoin probe per tick: across a healed partition
+        # the first datagram through re-introduces us to the other
+        # half (state push on first contact does the rest)
+        rejoin = self._rng.sample(dead_pool, 1) if dead_pool else []
+        for t in set(self._seeds) | set(known) | set(rejoin):
             if t != self.gossip_addr:
                 self._send(t, payload)
         # SWIM probe round for silent members: direct ping + indirect
@@ -295,6 +316,7 @@ class GossipDiscovery(Discovery):
                     info = prev[0] if prev else None
                 if info is not None:
                     self._members[sender] = (info, now)
+                    self._dead.pop(sender, None)  # rejoined
             # hearsay only INTRODUCES members, never refreshes them
             # (and only well-formed entries: a null/garbage info dict
             # stored here would crash every later tick's notify)
@@ -332,6 +354,10 @@ class GossipDiscovery(Discovery):
                     if now - seen > self.dead_s]
             for a in dead:
                 del self._members[a]
+                self._dead[a] = now  # rejoin-probe target (see _tick)
+            for a in [a for a, t in self._dead.items()
+                      if now - t > self.dead_retain_s]:
+                del self._dead[a]
             live = [_peer_info(i) for i, _ in self._members.values()]
         self._notify(sorted(live + [self.self_info],
                             key=lambda p: p.grpc_address))

@@ -305,14 +305,16 @@ class ShardedEngine:
         #: neither do we until this bound.
         self.auto_grow_limit = auto_grow_limit
         self.state = shard_table(self.mesh, capacity_per_shard)
-        # GUBER_STEP_DONATE=1 aliases the table in/out on the serving
-        # step (clean-step cold columns then pass through copy-free; see
-        # core/step.py › decide_batch_donated).  Off by default until
-        # the backend's in-place scatter lowering is measured fast
-        # (bench.py records both modes).
+        # The serving step aliases the table in/out by default
+        # (GUBER_STEP_DONATE=0 opts out): clean-step cold columns pass
+        # through copy-free and row scatters update in place (see
+        # core/step.py › decide_batch_donated).  Measured on a real v5e
+        # (tools/tpu_session.py, 2026-07-31): donate 0.573 ms/step vs
+        # copy 209 ms at CAP 2^21 — non-donated scatters serialize on
+        # TPU — and donate also wins 6.3× on CPU (PERF.md §5).
         self._step = make_sharded_step_packed(
             self.mesh,
-            donate=_os.environ.get("GUBER_STEP_DONATE", "0") == "1")
+            donate=_os.environ.get("GUBER_STEP_DONATE", "1") == "1")
         self._batch_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
         self._mat_sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
         self._repl = NamedSharding(self.mesh, P())

@@ -184,6 +184,79 @@ class TestGossipHardening:
                 node.close()
 
 
+class TestPartitionHeal:
+    def test_healed_partition_remerges_without_seeds(self):
+        """A full partition longer than dead_ms evicts both directions.
+        After the network heals, the rejoin probes to retained dead
+        members must re-merge the cluster even when no static seed
+        spans the cut (memberlist's dead-node reconnect behavior)."""
+        nodes, recs = spawn(3)
+        try:
+            assert wait_until(
+                lambda: all(r.latest() == ALL3 for r in recs), 15)
+            # no seed spans the cut: C keeps no seeds at all, and A/B
+            # were never seeded with C
+            nodes[2]._seeds = []
+            # cut {A,B} <-> {C} in both directions
+            c_addr = nodes[2].gossip_addr
+            ab_addrs = {nodes[0].gossip_addr, nodes[1].gossip_addr}
+            originals = [n._send for n in nodes]
+
+            def cut(node, blocked):
+                orig = node._send
+
+                def f(addr, payload, _orig=orig, _blocked=blocked):
+                    if addr in _blocked:
+                        return
+                    _orig(addr, payload)
+
+                node._send = f
+
+            cut(nodes[0], {c_addr})
+            cut(nodes[1], {c_addr})
+            cut(nodes[2], ab_addrs)
+            two, solo = ALL3[:2], ALL3[2:]
+            assert wait_until(
+                lambda: recs[0].latest() == two
+                and recs[1].latest() == two
+                and recs[2].latest() == solo, 15), \
+                (recs[0].latest(), recs[2].latest())
+            # heal: restore the original senders
+            for node, orig in zip(nodes, originals):
+                node._send = orig
+            # re-merge must come from the rejoin probes (C has no seeds
+            # and neither side has the other as a member any more)
+            assert wait_until(
+                lambda: all(r.latest() == ALL3 for r in recs), 15), \
+                [r.latest() for r in recs]
+        finally:
+            for node in nodes:
+                node.close()
+
+    def test_dead_retention_is_bounded(self):
+        """Dead entries expire after dead_retain_s — a departed node
+        does not collect rejoin probes forever."""
+        rec0, rec1 = Recorder(), Recorder()
+        n0 = GossipDiscovery(
+            rec0, "127.0.0.1:0", PeerInfo(grpc_address="10.0.0.0:81"),
+            known_hosts=[], interval_ms=100, suspect_ms=300, dead_ms=900,
+            dead_retain_ms=1500)
+        n1 = GossipDiscovery(
+            rec1, "127.0.0.1:0", PeerInfo(grpc_address="10.0.0.1:81"),
+            known_hosts=[n0.gossip_addr], interval_ms=100,
+            suspect_ms=300, dead_ms=900)
+        try:
+            two = ALL3[:2]
+            assert wait_until(
+                lambda: rec0.latest() == two and rec1.latest() == two, 15)
+            n1.close()
+            assert wait_until(lambda: rec0.latest() == two[:1], 10)
+            assert wait_until(lambda: not n0._dead, 10), n0._dead
+        finally:
+            n0.close()
+            n1.close()
+
+
 class TestMalformedDatagrams:
     def test_rx_survives_garbage(self):
         """Unauthenticated UDP: junk datagrams (bad JSON, wrong types,

@@ -31,6 +31,10 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       "/tmp/gubernator_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
+# runnable as `python tools/tpu_session.py` from anywhere: the repo
+# root must be on sys.path before gubernator_tpu/bench imports
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
 OUT = "/tmp/tpu_session.json"
 results: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
 
@@ -62,7 +66,6 @@ def main() -> int:
 
     # share the bench's key distribution + populate padding, so these
     # answers apply verbatim to the driver's bench run
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     from bench import _keyhash as keyhash, pad_chunk
 
     i64 = jnp.int64

@@ -527,6 +527,83 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
     except Exception as e:  # noqa: BLE001
         out["9_clustered_service"] = {"error": str(e)[:200]}
 
+    # -- SO_REUSEPORT front-door group (VERDICT r1 item 5): N daemon
+    # PROCESSES share one client gRPC port; kernel spreads connections;
+    # keys ring-split across per-process engines with raw-TLV peer
+    # forwards.  This is the aggregate host throughput a one-machine
+    # deployment front door actually delivers — real sockets, real
+    # serialization, every GIL boundary included.  Runs on the CPU
+    # backend by design (subprocesses can't share the TPU chip; on a
+    # TPU host these are the ingest workers).
+    if not os.environ.get("GUBER_BENCH_SKIP_GROUP"):
+        try:
+            import threading as _th
+
+            import grpc as _grpc
+
+            from gubernator_tpu.cluster import start_subprocess_group
+
+            n_procs = 2 if FAST else 4
+            grp = start_subprocess_group(n_procs, cache_size=1 << 16,
+                                         batch_rows=1024)
+            chans = []
+            try:
+                n_chan, reps_g = 4 * n_procs, 40
+                chans = [_grpc.insecure_channel(
+                    grp.client_address,
+                    options=[("grpc.use_local_subchannel_pool", 1)])
+                    for _ in range(n_chan)]
+                calls = [c.unary_unary("/pb.gubernator.V1/GetRateLimits")
+                         for c in chans]
+                # connect + warmup: timed traffic reuses these same
+                # connections, and each warmup batch ring-forwards
+                # sub-batches to EVERY process, so every engine has
+                # compiled its wave program before timing starts
+                for call in calls:
+                    call(datas[0], timeout=60)
+                lat_g = [[] for _ in range(n_chan)]
+
+                g_errors = []
+
+                def _gworker(t):
+                    try:
+                        for r in range(reps_g):
+                            t1 = time.perf_counter()
+                            calls[t](datas[(t + r) % 4], timeout=60)
+                            lat_g[t].append((time.perf_counter() - t1) * 1e3)
+                    except Exception as e2:  # noqa: BLE001
+                        g_errors.append(str(e2)[:120])
+
+                ths = [_th.Thread(target=_gworker, args=(t,))
+                       for t in range(n_chan)]
+                t0 = time.perf_counter()
+                for th in ths:
+                    th.start()
+                for th in ths:
+                    th.join()
+                wall = time.perf_counter() - t0
+                # numerator = calls that actually completed: a daemon
+                # dying mid-run must not inflate the rate
+                flat = [x for ls in lat_g for x in ls]
+                row = {
+                    "decisions_per_s": round(len(flat) * 1000 / wall),
+                    "processes": n_procs, "connections": n_chan}
+                if flat:
+                    row["p50_ms"] = round(float(np.percentile(flat, 50)), 3)
+                    row["p99_ms"] = round(float(np.percentile(flat, 99)), 3)
+                if g_errors:
+                    row["worker_errors"] = g_errors[:3]
+                out["10_reuseport_group"] = row
+            finally:
+                for c in chans:
+                    try:
+                        c.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                grp.stop()
+        except Exception as e:  # noqa: BLE001
+            out["10_reuseport_group"] = {"error": str(e)[:200]}
+
     # -- hot-set psum tier: replica-local GLOBAL decisions + one psum
     # fold per sync (the north-star replacement for global.go).
     try:

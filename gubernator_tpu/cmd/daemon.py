@@ -18,6 +18,9 @@ def main(argv=None) -> int:
     ap.add_argument("--config", default="", help="KEY=value config file")
     ap.add_argument("--grpc", default="", help="override GUBER_GRPC_ADDRESS")
     ap.add_argument("--http", default="", help="override GUBER_HTTP_ADDRESS")
+    ap.add_argument("--client", default="",
+                    help="override GUBER_CLIENT_ADDRESS (shared "
+                         "SO_REUSEPORT front door)")
     args = ap.parse_args(argv)
 
     from . import maybe_pin_platform
@@ -32,6 +35,8 @@ def main(argv=None) -> int:
         cfg.grpc_listen_address = args.grpc
     if args.http:
         cfg.http_listen_address = args.http
+    if args.client:
+        cfg.client_listen_address = args.client
     logging.basicConfig(
         level=getattr(logging, cfg.log_level.upper(), logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s")

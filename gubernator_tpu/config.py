@@ -161,9 +161,20 @@ class DaemonConfig:
 
     grpc_listen_address: str = "localhost:1051"
     http_listen_address: str = "localhost:1050"
+    #: Optional SHARED client-facing gRPC address bound with SO_REUSEPORT.
+    #: Several daemon processes on one host can bind the same
+    #: client_listen_address; the kernel load-balances inbound client
+    #: connections across them while each process keeps its unique
+    #: grpc_listen_address for peer traffic.  This is the front-door
+    #: scaling story for a GIL-bound host: N ingest processes share the
+    #: port, ring-split batches, and forward over the peer wire lane.
+    #: "" (default) disables the extra listener.
+    client_listen_address: str = ""
     advertise_address: str = ""
     cache_size: int = 1 << 16
     cache_autogrow_max: int = 0
+    #: Device wave rows per shard (Config.batch_rows).
+    batch_rows: int = 1024
     handover_on_reshard: bool = False
     data_center: str = ""
     instance_id: str = ""
@@ -198,6 +209,7 @@ class DaemonConfig:
         return Config(
             cache_size=self.cache_size,
             cache_autogrow_max=self.cache_autogrow_max,
+            batch_rows=self.batch_rows,
             handover_on_reshard=self.handover_on_reshard,
             behaviors=self.behaviors,
             data_center=self.data_center,
@@ -266,8 +278,11 @@ def setup_daemon_config(conf_file: str = "",
     d = DaemonConfig()
     d.grpc_listen_address = src.get("GUBER_GRPC_ADDRESS", d.grpc_listen_address)
     d.http_listen_address = src.get("GUBER_HTTP_ADDRESS", d.http_listen_address)
+    d.client_listen_address = src.get("GUBER_CLIENT_ADDRESS",
+                                      d.client_listen_address)
     d.advertise_address = src.get("GUBER_ADVERTISE_ADDRESS", d.advertise_address)
     d.cache_size = src.get("GUBER_CACHE_SIZE", d.cache_size, int)
+    d.batch_rows = src.get("GUBER_BATCH_ROWS", d.batch_rows, int)
     d.cache_autogrow_max = src.get("GUBER_CACHE_AUTOGROW_MAX",
                                    d.cache_autogrow_max, int)
     d.handover_on_reshard = src.get("GUBER_HANDOVER_ON_RESHARD",

@@ -150,6 +150,8 @@ class Daemon:
         self.discovery = None
         self.http_server: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
+        self.client_server: Optional[grpc.Server] = None
+        self.client_port: int = 0
 
         # --- gRPC listener FIRST: an ephemeral port (":0") must be
         # resolved to the real bound port before the advertise address
@@ -200,6 +202,35 @@ class Daemon:
             add_peers_servicer_raw(self.grpc_server,
                                    _PeersServicer(self.instance))
             add_health_servicer(self.grpc_server, self.instance)
+
+            if cfg.client_listen_address:
+                # Shared front door: V1 (+ health) on a SO_REUSEPORT
+                # socket so sibling daemon processes on this host can
+                # bind the same address and split inbound connections.
+                # Peer traffic stays on the unique grpc_listen_address —
+                # the ring needs per-process identities.  Bound BEFORE
+                # the peer server starts: readiness probes watch the
+                # peer port's health service, and SERVING there must
+                # imply the front door is already accepting.
+                self.client_server = grpc.server(
+                    ThreadPoolExecutor(max_workers=32),
+                    options=[("grpc.so_reuseport", 1)])
+                add_v1_servicer_raw(self.client_server,
+                                    _V1Servicer(self.instance))
+                add_health_servicer(self.client_server, self.instance)
+                if self.tls is not None:
+                    cbound = self.client_server.add_secure_port(
+                        cfg.client_listen_address,
+                        self.tls.grpc_server_credentials())
+                else:
+                    cbound = self.client_server.add_insecure_port(
+                        cfg.client_listen_address)
+                if cbound == 0:
+                    raise OSError(
+                        f"failed to bind client address "
+                        f"{cfg.client_listen_address} (SO_REUSEPORT)")
+                self.client_port = cbound
+                self.client_server.start()
             self.grpc_server.start()
 
             if cfg.http_listen_address:
@@ -300,6 +331,8 @@ class Daemon:
     def _teardown(self) -> None:
         if self.discovery is not None:
             self.discovery.close()
+        if self.client_server is not None:
+            self.client_server.stop(grace=2).wait(timeout=5)
         self.grpc_server.stop(grace=2).wait(timeout=5)
         if self.http_server is not None:
             self.http_server.shutdown()

@@ -1,0 +1,113 @@
+"""SO_REUSEPORT front-door group: N daemon subprocesses share one
+client port; the kernel spreads connections; keys stay ring-consistent
+because every process forwards non-owned sub-batches over the peer
+wire lane.
+
+reference: the reference scales its front door with goroutines inside
+one process (workers.go); a GIL-bound host scales with processes, so
+the equivalent deployment is this group (VERDICT r1 item 5).
+"""
+from __future__ import annotations
+
+import socket
+import sys
+
+import grpc
+import pytest
+
+from gubernator_tpu.cluster import start_subprocess_group
+from gubernator_tpu.proto import gubernator_pb2 as pb
+
+pytestmark = pytest.mark.skipif(
+    not hasattr(socket, "SO_REUSEPORT") or not sys.platform.startswith("linux"),
+    reason="SO_REUSEPORT group is a Linux deployment shape")
+
+
+def _raw_channel(addr: str) -> grpc.Channel:
+    # use_local_subchannel_pool: each channel gets its own TCP
+    # connection, so SO_REUSEPORT can spread them across processes
+    # (the global pool would collapse same-target channels onto one
+    # subchannel = one process).
+    return grpc.insecure_channel(
+        addr, options=[("grpc.use_local_subchannel_pool", 1)])
+
+
+def _batch(key: str, hits: int, limit: int = 1_000_000) -> bytes:
+    m = pb.GetRateLimitsReq()
+    r = m.requests.add()
+    r.name = "group"
+    r.unique_key = key
+    r.hits = hits
+    r.limit = limit
+    r.duration = 60_000
+    return m.SerializeToString()
+
+
+@pytest.fixture(scope="module")
+def group():
+    g = start_subprocess_group(2, cache_size=1 << 12, batch_rows=256)
+    yield g
+    g.stop()
+
+
+def test_group_conserves_hits_across_connections(group):
+    """The same key hit over many distinct connections (landing on
+    whichever process the kernel picks) must drain exactly once per
+    hit: ownership is ring-global, not per-process."""
+    chans = [_raw_channel(group.client_address) for i in range(12)]
+    calls = [c.unary_unary("/pb.gubernator.V1/GetRateLimits")
+             for c in chans]
+    try:
+        total = 0
+        for i, call in enumerate(calls):
+            data = call(_batch("shared-key", hits=3), timeout=30)
+            total += 3
+            resp = pb.GetRateLimitsResp.FromString(data)
+            assert resp.responses[0].status == 0  # UNDER_LIMIT
+        # hits=0 query reads without consuming
+        data = calls[0](_batch("shared-key", hits=0), timeout=30)
+        resp = pb.GetRateLimitsResp.FromString(data)
+        assert resp.responses[0].remaining == 1_000_000 - total
+    finally:
+        for c in chans:
+            c.close()
+
+
+def test_group_spreads_connections(group):
+    """With 12 distinct connections over 2 processes, both processes
+    should see client traffic (P[all land on one] ≈ 2^-11)."""
+    import urllib.request
+
+    chans = [_raw_channel(group.client_address) for i in range(12)]
+    calls = [c.unary_unary("/pb.gubernator.V1/GetRateLimits")
+             for c in chans]
+    try:
+        for i, call in enumerate(calls):
+            call(_batch(f"spread-{i}", hits=1), timeout=30)
+    finally:
+        for c in chans:
+            c.close()
+    seen = 0
+    for addr in group.http_addresses:
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=10) as f:
+            text = f.read().decode()
+        # any client-lane counter > 0 means this process served ingress
+        got = any(
+            line.split()[-1] not in ("0", "0.0")
+            for line in text.splitlines()
+            if line.startswith("gubernator_wire_lane_requests_total")
+            and ('lane="wire_local"' in line
+                 or 'lane="wire_clustered"' in line
+                 or 'lane="pb2_fallback"' in line))
+        seen += bool(got)
+    assert seen == 2, "kernel did not spread connections (or metrics lane missing)"
+
+
+def test_group_health_on_shared_port(group):
+    ch = _raw_channel(group.client_address)
+    try:
+        check = ch.unary_unary("/grpc.health.v1.Health/Check")
+        assert check(b"", timeout=10) == bytes([0x08, 0x01])
+    finally:
+        ch.close()

@@ -16,9 +16,14 @@ order); each segment (same key) is applied serially-equivalently:
 - "simple" tails (uniform request fields, no RESET/DRAIN flags) have a
   closed form: with per-request cost c and remaining r after position 0,
   position j ≥ 1 is admitted iff j ≤ r // c;
-- everything else (mixed hits/configs/flags on one key) runs a
-  while_loop over in-segment positions, vectorized across segments —
-  bounded by the longest such segment, zero iterations when absent.
+- LEAKY tails with uniform config but MIXED arrival times take a
+  speculative segmented associative scan (maps x → min(m, x+b) compose
+  closedly); segments where the speculation fails (any deny) fall back
+  to the loop below;
+- everything else (mixed hits/configs/flags on one key, or mixed-time
+  leaky segments that actually deny) runs a while_loop over in-segment
+  positions, vectorized across segments — bounded by the longest such
+  segment, zero iterations when absent.
 
 All arithmetic is int64 (x64 enabled); semantics match oracle.py
 bit-for-bit — the parity tests enforce this on random + Zipf streams.
@@ -431,6 +436,109 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     o_rem = jnp.where(tail_mask, t_rem_out, o_rem)
     o_reset = jnp.where(tail_mask, out0[2][sid], o_reset)
     o_limit = jnp.where(tail_mask, out0[3][sid], o_limit)
+
+    # ---- leaky mixed-time tails: speculative associative scan ----------
+    # The last per-position exposure (ROUND_NOTES r2 open #4).  A
+    # uniform-config, no-flag LEAKY segment whose arrivals mix instants
+    # has the exact per-position transition (on the clamped clock
+    # e_j = max(now_j, e_{j-1}), d_j = e_j - e_{j-1}):
+    #     u_j = min(cap_td, r_{j-1} + d_j*limit)        (replenish)
+    #     r_j = u_j - c  if c <= u_j (allow)  else  u_j (deny)
+    # Crossing the expiry inside such a segment is EXACTLY replenish
+    # saturation for leaky (fresh rem = burst*eff = cap_td, same t/exp
+    # writes), so expiry needs no special case.  SPECULATE that every
+    # tail position is allowed: each position becomes x -> min(m, x+b)
+    # with m = cap_td - c, b = d_j*limit - c, and such maps compose
+    # closedly: (m1,b1) then (m2,b2) = (min(m2, m1+b2), b1+b2) — a
+    # segmented associative scan yields every prefix in O(log B)
+    # instead of a while_loop iteration per position.  Validation: the
+    # speculation holds iff min_j r_j >= 0 (nothing was denied);
+    # segments where it fails keep the while_loop.  Queries (hits == 0)
+    # consume nothing, never fail, and propagate the item status —
+    # flipping to 0 once any position crossed the expiry (the fresh
+    # reset).  The deny branch itself is non-monotone (a denied caller
+    # keeps more tokens than an allowed one), which is why the general
+    # mixed allow/deny case has no bounded-state scan.
+    lseg = (exists & uniform_cfg & (~any_flag) & is_leaky0
+            & (~uni_now) & (seg_len > 1))
+
+    def _leaky_mixed_scan(carry):
+        (os_, or_, ot_, ol_), item_f, cplx = carry
+        i64max = _I64_MAX
+        INF = jnp.asarray(1 << 62, i64)
+        LOWC = jnp.asarray(-(1 << 62), i64)
+        now_s = sf.now
+        T = item1.t[sid]  # head's post-apply clock, per position
+        e = jnp.maximum(now_s, T)
+        now_prev = jnp.concatenate([now_s[:1], now_s[:-1]])
+        e_prev = jnp.where(pos > 0, jnp.maximum(now_prev, T), T)
+        d = jnp.maximum(e - e_prev, 0)
+        L = sf.limit
+        effp = jnp.maximum(sf.eff, 1)
+        c = sf.hits * jnp.where(lseg[sid], effp, 1)  # mask: token
+        # hits*eff of a non-participating segment may wrap int64
+        cap_td = sf.burst * jnp.where(lseg[sid], effp, 1)
+        safe_el = TD_BOUND // jnp.maximum(L, 1)
+        tail_sel = lseg[sid] & (pos > 0)
+        m_el = jnp.where(tail_sel, cap_td - c, INF)
+        # d >= eff crosses the expiry: the bucket goes FRESH (rem =
+        # burst*eff = cap_td) — NOT mere replenishment, which would
+        # under-fill whenever burst > limit and d*limit < cap_td.
+        # d > safe_el is the int64 overflow guard (same arm: the true
+        # product exceeds every cap).
+        b_raw = jnp.where((d >= effp) | (d > safe_el), cap_td - c,
+                          jnp.minimum(d, safe_el) * L - c)
+        # low clamp preserves "speculation fails" (r0 <= cap_td < 2^61
+        # so r0 + LOWC < 0 always) while keeping every later sum in
+        # int64 range; identity positions contribute (INF, 0)
+        b_el = jnp.where(tail_sel, jnp.maximum(b_raw, LOWC), 0)
+        flag = pos == 1  # segment start, for the segmented combine
+
+        def comb(lft, rgt):
+            ml, bl, fl = lft
+            mr, br, fr = rgt
+            m = jnp.minimum(mr, ml + br)
+            b = jnp.minimum(jnp.maximum(bl + br, LOWC), m)
+            return (jnp.where(fr, mr, m), jnp.where(fr, br, b), fl | fr)
+
+        M, Bc, _ = lax.associative_scan(comb, (m_el, b_el, flag))
+        r0 = item1.rem[sid]
+        r = jnp.minimum(M, r0 + Bc)
+        min_r = jax.ops.segment_min(
+            jnp.where(tail_sel, r, i64max), seg_id, num_segments=B)
+        ok_seg = lseg & (min_r >= 0)
+
+        # per-position outputs (only adopted where ok_seg & tail)
+        is_query = c == 0
+        fi = (tail_sel & (d >= effp)).astype(i32)
+        cs = jnp.cumsum(fi)
+        cs_head = cs.at[seg_start[sid]].get(mode="fill", fill_value=0)
+        crossed = (cs - cs_head) > 0  # any expiry crossing at <= this pos
+        st_pos = jnp.where(is_query,
+                           jnp.where(crossed, 0, item1.status[sid]),
+                           0).astype(i32)
+        rate = jnp.where(L > 0, effp // jnp.maximum(L, 1), effp)
+        ap = ok_seg[sid] & tail_sel
+        os_ = jnp.where(ap, st_pos, os_)
+        or_ = jnp.where(ap, r // effp, or_)
+        ot_ = jnp.where(ap, e + rate, ot_)
+        ol_ = jnp.where(ap, L, ol_)
+
+        # per-segment final item from the last tail position
+        idxL = jnp.where(ok_seg, seg_start + seg_len - 1, B).astype(i32)
+
+        def glast(x, fill=0):
+            return x.at[idxL].get(mode="fill", fill_value=fill)
+
+        item_scan = item1._replace(
+            status=glast(st_pos), rem=glast(r), t=glast(e),
+            exp=glast(e) + item1.eff)
+        item_f = _tree_where(ok_seg, item_scan, item_f)
+        return (os_, or_, ot_, ol_), item_f, cplx & (~ok_seg)
+
+    (o_status, o_rem, o_reset, o_limit), item_final, complex_seg = lax.cond(
+        lseg.any(), _leaky_mixed_scan, lambda carry: carry,
+        ((o_status, o_rem, o_reset, o_limit), item_final, complex_seg))
 
     # ---- complex tails: while_loop over in-segment positions -----------
     max_complex = jnp.max(jnp.where(complex_seg, seg_len, 0))

@@ -1,0 +1,176 @@
+"""Leaky mixed-arrival-time tails: the speculative associative-scan
+path (core/step.py) must match the sequential oracle exactly — both
+when the speculation holds (no denies: the scan's answer is adopted)
+and when it fails (denies: the segment falls back to the while_loop).
+
+reference: algorithms.go › leakyBucket applied per request in arrival
+order — reconstructed, mount empty.  The engine packs merged callers
+(distinct clocks) into one launch; parity target is the oracle applied
+at each request's own time, ascending.
+"""
+import numpy as np
+import pytest
+
+from gubernator_tpu import Algorithm, Oracle, RateLimitRequest
+from gubernator_tpu.core.batch import pack_requests
+from gubernator_tpu.hashing import hash_request_keys
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+
+NOW = 1_700_000_000_000
+HOUR = 3_600_000
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 10,
+                         batch_per_shard=256)
+
+
+def run_merged(engine, jobs):
+    """Pack per-time jobs into ONE launch (per-request now column) and
+    return engine outputs + sequential oracle expectations."""
+    oracle = Oracle()
+    packed, want = [], []
+    for reqs, now in jobs:
+        kh = hash_request_keys([r.name for r in reqs],
+                               [r.unique_key for r in reqs])
+        b, errs = pack_requests(reqs, now, size=len(reqs), key_hashes=kh)
+        assert not any(errs)
+        packed.append((b, kh))
+        want.extend(oracle.check_batch(reqs, now))
+    batch = type(packed[0][0])(*[
+        np.concatenate([np.asarray(p[0][f]) for p in packed])
+        for f in range(len(packed[0][0]))])
+    khash = np.concatenate([p[1] for p in packed])
+    st, lim, rem, rst, full = engine.check_packed(batch, khash,
+                                                  jobs[-1][1])
+    assert not full.any()
+    return st, lim, rem, rst, want
+
+
+def leaky(key, hits, limit=50, burst=100, duration=HOUR, name="lms"):
+    return RateLimitRequest(name=name, unique_key=key, hits=hits,
+                            limit=limit, duration=duration,
+                            algorithm=Algorithm.LEAKY_BUCKET, burst=burst)
+
+
+def assert_parity(st, lim, rem, rst, want, ctx=""):
+    for g, w in enumerate(want):
+        assert (int(st[g]), int(rem[g]), int(rst[g]), int(lim[g])) == \
+            (int(w.status), w.remaining, w.reset_time, w.limit), \
+            (ctx, g, w)
+
+
+def test_all_allowed_mixed_times_one_hot_key(engine):
+    """The speculation-success case: one hot leaky key, 24 requests at
+    8 distinct instants, generous burst — every position allowed, the
+    whole tail rides the scan."""
+    jobs = [([leaky("hot", hits=2)] * 3, NOW + i * 977) for i in range(8)]
+    assert_parity(*run_merged(engine, jobs), "allow")
+
+
+def test_denies_force_fallback_parity(engine):
+    """Speculation-failure case: tight limit so mid-segment denies
+    occur; the while_loop fallback must produce oracle parity too."""
+    jobs = [([leaky("tight", hits=7, limit=3, burst=10)] * 2,
+             NOW + i * 1733) for i in range(6)]
+    st, lim, rem, rst, want = run_merged(engine, jobs)
+    assert any(int(w.status) == 1 for w in want)  # denies really happened
+    assert_parity(st, lim, rem, rst, want, "deny")
+
+
+def test_replenish_between_instants(engine):
+    """Arrival gaps large enough to replenish tokens change the allow
+    pattern vs uniform-time application — exactly what the scan's
+    min-plus composition must capture."""
+    # limit 10/hour => 1 token per 360_000 ms; drain 5 then wait to
+    # replenish a few
+    jobs = [
+        ([leaky("rep", hits=5, limit=10, burst=10)], NOW),
+        ([leaky("rep", hits=5, limit=10, burst=10)], NOW + 1),
+        ([leaky("rep", hits=3, limit=10, burst=10)], NOW + 2 * 360_000),
+        ([leaky("rep", hits=1, limit=10, burst=10)], NOW + 2 * 360_000 + 5),
+    ]
+    assert_parity(*run_merged(engine, jobs), "replenish")
+
+
+def test_expiry_crossing_inside_segment(engine):
+    """A gap past the duration makes the bucket fresh mid-segment; for
+    leaky this equals replenish saturation — the scan must agree."""
+    jobs = [
+        ([leaky("exp", hits=90, limit=50, burst=100, duration=10_000)], NOW),
+        ([leaky("exp", hits=1, limit=50, burst=100, duration=10_000)],
+         NOW + 25_000),  # past expiry: fresh bucket
+        ([leaky("exp", hits=2, limit=50, burst=100, duration=10_000)],
+         NOW + 25_001),
+    ]
+    assert_parity(*run_merged(engine, jobs), "expiry")
+
+
+def test_expiry_crossing_burst_exceeds_limit(engine):
+    """Regression (r2 code review): with burst > limit, an expiry
+    crossing must reset the bucket to burst*eff (FRESH), not merely
+    replenish d*limit — for eff <= d < (burst/limit)*eff those
+    differ, and the under-filled bucket would wrongly deny the next
+    burst-1 legitimate hits."""
+    eff = 60_000
+    jobs = [
+        # drain a limit=1 burst=10 bucket to 0
+        ([leaky("bl", hits=10, limit=1, burst=10, duration=eff)], NOW),
+        # second arrival exactly one duration later: d == eff crosses
+        # the expiry, but d*limit = eff << cap_td = 10*eff
+        ([leaky("bl", hits=1, limit=1, burst=10, duration=eff)],
+         NOW + eff),
+        # the fresh bucket must now serve 9 more hits
+        ([leaky("bl", hits=9, limit=1, burst=10, duration=eff)],
+         NOW + eff + 1),
+    ]
+    st, lim, rem, rst, want = run_merged(engine, jobs)
+    assert int(want[1].status) == 0 and want[1].remaining == 9
+    assert_parity(st, lim, rem, rst, want, "burst>limit crossing")
+
+
+def test_query_only_mixed_times(engine):
+    """hits=0 queries at mixed instants: no consumption, status
+    propagates (flipping to UNDER after an expiry crossing), remaining
+    reflects replenishment."""
+    # drain to OVER first, then query at later instants
+    jobs = [
+        ([leaky("q", hits=100, limit=50, burst=100, duration=10_000)], NOW),
+        ([leaky("q", hits=100, limit=50, burst=100, duration=10_000)],
+         NOW + 1),  # denied -> status OVER stored
+        ([leaky("q", hits=0, limit=50, burst=100, duration=10_000)],
+         NOW + 100),
+        ([leaky("q", hits=0, limit=50, burst=100, duration=10_000)],
+         NOW + 30_000),  # past expiry: fresh/full
+    ]
+    assert_parity(*run_merged(engine, jobs), "query")
+
+
+def test_many_keys_mixed_scan_and_simple(engine):
+    """A wave mixing: scan-eligible leaky segments, token segments (the
+    existing closed form), singletons, and a deny-heavy leaky segment —
+    every routing decision in one launch."""
+    rng = np.random.default_rng(42)
+    jobs = []
+    for i in range(6):
+        reqs = []
+        for k in range(5):
+            reqs.append(leaky(f"mk{k}", hits=int(rng.integers(1, 4)),
+                              limit=30, burst=60))
+        reqs.append(leaky("mtight", hits=9, limit=4, burst=8))
+        reqs.append(RateLimitRequest(
+            name="lms", unique_key="tok", hits=1, limit=100,
+            duration=HOUR, algorithm=Algorithm.TOKEN_BUCKET))
+        reqs.append(leaky(f"solo{i}", hits=1))
+        jobs.append((reqs, NOW + i * 611 + int(rng.integers(0, 50))))
+    assert_parity(*run_merged(engine, jobs), "mixed-wave")
+
+
+def test_big_segment_scan_vs_loop_equivalence(engine):
+    """256 mixed-time requests on one key, all allowed: the scan path
+    must agree with the oracle across a long prefix chain (this is the
+    shape whose while_loop cost motivated the scan)."""
+    jobs = [([leaky("big", hits=1, limit=1000, burst=4000)],
+             NOW + i * 37) for i in range(256)]
+    assert_parity(*run_merged(engine, jobs), "big")

@@ -181,3 +181,62 @@ class TestOwnerIndices:
         peers = picker.owner_peers()
         for h, j in zip(edge.tolist(), idx.tolist()):
             assert picker.get_by_hash(h) is peers[j]
+
+
+class TestWorkerOnlyIngest:
+    """Heterogeneous front-door shape (ARCHITECTURE.md §3.1): a daemon
+    whose ring omits itself owns NO keys and forwards every request to
+    the owners — the ingest-worker role on a TPU host, where CPU
+    workers absorb the parse/split/assembly GIL cost and the single
+    device-owner daemon pays only the columnar peer-apply."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        c = cluster_mod.start(2)
+        owner, worker = c.daemon_at(0), c.daemon_at(1)
+        # worker's ring lists only the owner; owner serves solo
+        owner.set_peers([owner.peer_info()])
+        worker.set_peers([owner.peer_info()])
+        yield c
+        c.stop()
+
+    def test_worker_forwards_everything_with_parity(self, pair):
+        owner, worker = pair.instance_at(0), pair.instance_at(1)
+        oracle = Oracle()
+        peer_before = lane_count(owner, "peer_wire")
+        lane_before = lane_count(worker, "wire_clustered")
+        for w in range(2):
+            reqs = mk_wave(w)
+            now = clock_ms()
+            want = oracle.check_batch(reqs, now)
+            out = pb.GetRateLimitsResp.FromString(
+                worker.get_rate_limits_wire(serialize(reqs), now_ms=now))
+            assert len(out.responses) == len(reqs)
+            for i, (g, e) in enumerate(zip(out.responses, want)):
+                assert g.error == "", (w, i, g.error)
+                assert (int(g.status), int(g.remaining), int(g.limit)) == \
+                    (int(e.status), int(e.remaining), int(e.limit)), \
+                    (w, i, reqs[i])
+        n_total = 2 * len(mk_wave(0))
+        # worker still rides the columnar clustered lane...
+        assert lane_count(worker, "wire_clustered") - lane_before == n_total
+        # ...and owns nothing: every decision crossed the peer wire
+        assert lane_count(owner, "peer_wire") - peer_before == n_total
+
+    def test_bucket_shared_between_worker_and_owner_entry(self, pair):
+        """The same key drained through the worker and directly at the
+        owner must hit one shared bucket (ownership is ring-global)."""
+        owner, worker = pair.instance_at(0), pair.instance_at(1)
+        now = clock_ms()
+
+        def one(hits):
+            return serialize([RateLimitRequest(
+                name="wo", unique_key="shared", hits=hits, limit=10,
+                duration=DAY)])
+
+        r1 = pb.GetRateLimitsResp.FromString(
+            worker.get_rate_limits_wire(one(4), now_ms=now))
+        r2 = pb.GetRateLimitsResp.FromString(
+            owner.get_rate_limits_wire(one(4), now_ms=now))
+        assert int(r1.responses[0].remaining) == 6
+        assert int(r2.responses[0].remaining) == 2

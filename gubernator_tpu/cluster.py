@@ -130,8 +130,8 @@ class SubprocessGroup:
 def start_subprocess_group(n: int, cache_size: int = 1 << 16,
                            batch_rows: int = 1024,
                            ready_timeout: float = 120.0,
-                           env_extra: Optional[dict] = None
-                           ) -> SubprocessGroup:
+                           env_extra: Optional[dict] = None,
+                           client_port: int = 0) -> SubprocessGroup:
     """Spawn ``n`` daemon subprocesses sharing one SO_REUSEPORT client
     port, statically clustered over unique peer ports.  Blocks until
     every process answers grpc.health.v1 SERVING on its peer port.
@@ -149,9 +149,19 @@ def start_subprocess_group(n: int, cache_size: int = 1 << 16,
 
     import grpc as _grpc
 
-    client_address = f"127.0.0.1:{free_port()}"
-    grpc_addresses = [f"127.0.0.1:{free_port()}" for _ in range(n)]
-    http_addresses = [f"127.0.0.1:{free_port()}" for _ in range(n)]
+    client_address = f"127.0.0.1:{client_port or free_port()}"
+
+    def draw_port() -> int:
+        # never hand a worker the user-chosen client port: the daemon
+        # would try to bind it both as its peer listener and as the
+        # SO_REUSEPORT front door, and fail confusingly
+        while True:
+            p = free_port()
+            if p != client_port:
+                return p
+
+    grpc_addresses = [f"127.0.0.1:{draw_port()}" for _ in range(n)]
+    http_addresses = [f"127.0.0.1:{draw_port()}" for _ in range(n)]
     procs, log_paths = [], []
     try:
         for i in range(n):

@@ -1,0 +1,31 @@
+"""Shared persistent-compile-cache setup (stdlib-only, import before jax).
+
+One place owns the cache-dir choice for every entry point that compiles
+device programs (bench.py, tests/conftest.py, tools/*, __graft_entry__):
+repo-local `.jax_cache/` by preference — /tmp is wiped between build
+sessions while the repo workspace persists, so a repo-local cache carries
+warm compiles (200-300 s each over the tunnel) across sessions and into
+the driver's end-of-round bench — falling back to /tmp when the repo dir
+is missing OR unwritable (read-only checkout, foreign-owner dir).
+"""
+import os
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def setup() -> str:
+    """Point JAX_COMPILATION_CACHE_DIR at a writable persistent dir."""
+    cache = os.path.join(_REPO, ".jax_cache")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        if not os.access(cache, os.W_OK):
+            raise OSError("unwritable")
+    except OSError:
+        cache = "/tmp/gubernator_jax_cache"
+        try:
+            os.makedirs(cache, exist_ok=True)
+        except OSError:
+            pass
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]

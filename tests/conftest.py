@@ -14,9 +14,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 # Persistent compile cache: the step program is large; don't re-pay XLA
-# compilation on every pytest invocation.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gubernator_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# compilation on every pytest invocation (_jax_cache owns the dir choice).
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _jax_cache
+
+_jax_cache.setup()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402

@@ -26,7 +26,9 @@ def daemon_proc():
         GUBER_JAX_PLATFORM="cpu",
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=2",
-        JAX_COMPILATION_CACHE_DIR="/tmp/gubernator_jax_cache",
+        # JAX_COMPILATION_CACHE_DIR is inherited from os.environ
+        # (conftest ran _jax_cache.setup()), so the daemon subprocess
+        # shares the warm repo-local cache
         GUBER_CACHE_SIZE="4096",
     )
     p = subprocess.Popen(

@@ -1,0 +1,103 @@
+"""Headline-only batch-size sweep on a live chip.
+
+Measures the north-star sustained rate (10M resident keys, CAP 2^24)
+at one or more device batch sizes WITHOUT the full bench's secondary
+configs — each new B is two cold compiles (copy + donate) over the
+tunnel, so this isolates the sweep VERDICT r1 item 1 asked for.
+
+    timeout 3600 python tools/b_sweep.py 131072 [262144 ...]
+
+Checkpoints one JSON object per B (atomic, pid-isolated so concurrent
+sweeps can't clobber each other) and prints the full list at the end —
+copy results that matter into BASELINE.md; /tmp does not survive the
+session.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+import bench  # noqa: E402  (sets the repo-local compile cache)
+
+OUT = f"/tmp/b_sweep.{os.getpid()}.json"
+
+
+def run_one(B: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.core.step import decide_batch, decide_batch_donated
+    from gubernator_tpu.core.table import init_table
+
+    # workload identity comes from bench so the sweep measures EXACTLY
+    # the headline's distribution (same constants, same env overrides);
+    # the measurement loop mirrors bench.main's measure_mode/populate
+    # (kept monolithic there — that file is the driver's entry point)
+    N_KEYS, CAP, NOW0 = bench.N_KEYS, bench.CAP, bench.NOW0
+    i64 = jnp.int64
+    rng = np.random.default_rng(42)
+    n_batches = 8
+    draws = rng.zipf(bench.ZIPF_A, size=n_batches * B) % N_KEYS
+    kb = [jnp.asarray(bench._keyhash(draws[i * B:(i + 1) * B].astype(np.uint64)))
+          for i in range(n_batches)]
+    const = dict(
+        hits=jnp.ones(B, i64), limit=jnp.full(B, bench.LIMIT, i64),
+        duration=jnp.full(B, bench.DURATION_MS, i64),
+        eff_ms=jnp.full(B, bench.DURATION_MS, i64),
+        greg_end=jnp.zeros(B, i64), behavior=jnp.zeros(B, jnp.int32),
+        algorithm=jnp.zeros(B, jnp.int32),
+        burst=jnp.full(B, bench.LIMIT, i64),
+        valid=jnp.ones(B, bool))
+
+    def mk(keys):
+        return RequestBatch(key=keys, **const)
+
+    row = {"B": B, "backend": jax.default_backend()}
+    for label, fn in (("copy", decide_batch), ("donate", decide_batch_donated)):
+        try:
+            st = init_table(CAP)
+            t0 = time.perf_counter()
+            st, out = fn(st, mk(kb[0]), jnp.asarray(NOW0, i64))
+            out.status.block_until_ready()
+            row[f"{label}_compile_s"] = round(time.perf_counter() - t0, 1)
+            ids = np.arange(N_KEYS, dtype=np.uint64)
+            for a in range(0, N_KEYS, B):
+                chunk = bench.pad_chunk(ids[a:a + B], B)
+                st, out = fn(st, mk(jnp.asarray(bench._keyhash(chunk))),
+                             jnp.asarray(NOW0, i64))
+            out.status.block_until_ready()
+            reps = max(8, int(30_000_000 / B))
+            t0 = time.perf_counter()
+            for r in range(reps):
+                st, out = fn(st, mk(kb[r % n_batches]),
+                             jnp.asarray(NOW0 + 100 + r, i64))
+            out.status.block_until_ready()
+            dt = time.perf_counter() - t0
+            row[f"{label}_mdps"] = round(reps * B / dt / 1e6, 1)
+            row[f"{label}_ms_per_step"] = round(dt / reps * 1e3, 3)
+        except Exception as e:  # noqa: BLE001
+            row[f"{label}_error"] = str(e)[:200]
+        print(f"[b_sweep] {row}", file=sys.stderr, flush=True)
+    return row
+
+
+def main() -> None:
+    bs = [int(a) for a in sys.argv[1:]] or [131072]
+    rows = []
+    for B in bs:
+        rows.append(run_one(B))
+        # atomic checkpoint (same pattern as bench._write_partial): a
+        # timeout-kill mid-write must not cost completed rows
+        tmp = OUT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rows, f, indent=1)
+        os.replace(tmp, OUT)
+    print(json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,13 +27,12 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/gubernator_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-
 # runnable as `python tools/tpu_session.py` from anywhere: the repo
 # root must be on sys.path before gubernator_tpu/bench imports
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+import _jax_cache  # persistent compile cache (shared dir choice)
+
+_jax_cache.setup()
 
 OUT = "/tmp/tpu_session.json"
 results: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}

@@ -280,30 +280,20 @@ def main():
         log(f"latency section failed: {e!r:.200}")
 
     # client-shaped latency: one max-size GetRateLimits batch (1000 reqs
-    # in a 1024 bucket) per device call — the p99<2ms target's shape
-    p50_c = p99_c = -1.0
-    try:
-        Bc = 1024
-        small = RequestBatch(
-            key=key_batches[0][:Bc],
-            **{k: (v[:Bc] if hasattr(v, "shape") else v)
-               for k, v in const.items()})
-        state_c = init_table(CAP)
-        state_c, outc = step_best(state_c, small, jnp.asarray(NOW0, i64))
-        outc.status.block_until_ready()
-        lats_c = []
-        for i in range(100):
-            t0 = time.perf_counter()
-            state_c, outc = step_best(state_c, small,
-                                      jnp.asarray(NOW0 + i, i64))
-            outc.status.block_until_ready()
-            lats_c.append((time.perf_counter() - t0) * 1e3)
-        p50_c = float(np.percentile(lats_c, 50))
-        p99_c = float(np.percentile(lats_c, 99))
+    # in a 1024 bucket) per device call — the p99<2ms target's shape.
+    # Fresh-compile section: on a device backend it runs in a CHILD
+    # process so a wedged compile (observed 2026-07-31: this exact
+    # shape hung the tunnel's compile server for 40+ min) costs this
+    # row, not the rest of the run.
+    os.environ["GUBER_BENCH_STEP_MODE"] = step_mode
+    lat_rows = _run_section("lat_client", inline=(backend == "cpu"))
+    p50_c = float(lat_rows.get("client_batch_p50_ms", -1.0))
+    p99_c = float(lat_rows.get("client_batch_p99_ms", -1.0))
+    if "error" in lat_rows:
+        log(f"client-batch latency section: {lat_rows['error']}")
+    else:
         log(f"client-batch latency: p50={p50_c:.3f}ms p99={p99_c:.3f}ms "
-            f"(batch={Bc})")
-    except Exception as e:  # noqa: BLE001
-        log(f"client-batch latency section failed: {e!r:.200}")
+            f"(batch=1024)")
 
     # host-side string-hash throughput (the other half of a real dispatch)
     from gubernator_tpu.hashing import hash_keys
@@ -332,8 +322,7 @@ def main():
         result["extra"]["baseline_configs"] = cfgs
         _write_partial(result)
 
-    configs = run_secondary_configs(jnp, decide_batch, const, step_mode,
-                                    checkpoint=ck)
+    configs = run_secondary_configs(step_mode, backend, checkpoint=ck)
     result["extra"]["baseline_configs"] = configs
     _write_partial(result)
     print(json.dumps(result))
@@ -366,126 +355,182 @@ def _sustain(decide_batch, jnp, state, batches, reps, now0):
     return reps * batches[0].key.shape[0] / dt, state
 
 
-def run_secondary_configs(jnp, decide_batch, const_proto,
-                          step_mode="copy", checkpoint=None):
-    """BASELINE.md configs 1/2/4/5 (config 3 is the headline above).
-    Smaller rep counts — these document shape coverage, not the record.
-    ``checkpoint(out)`` is called after each config so rows measured
-    before a late-stage device failure survive (see _write_partial)."""
-    import jax
+# ---- sections -----------------------------------------------------------
+#
+# Every secondary config (and the client-batch latency probe) is a
+# SECTION: a self-contained function that builds its own inputs, runs,
+# and returns a dict of result rows.  On the CPU backend sections run
+# inline (no wedge risk, no re-init cost).  On a device backend each
+# runs in a CHILD process: a section needs its own cold compile, and a
+# wedged tunnel compile (observed twice on 2026-07-31) otherwise stalls
+# the whole run — child isolation turns "lost the rest of the bench"
+# into "lost one row".  After a section timeout the parent probes the
+# device link; if the probe fails, remaining device sections are
+# skipped with an explicit note instead of burning their timeouts.
 
-    # serving engines built below (V1Instance, the 3-daemon cluster)
-    # read this at construction: they must run the mode that won —
-    # set it explicitly BOTH ways so a pre-existing operator export
-    # can't make the rows measure a different mode than reported
-    os.environ["GUBER_STEP_DONATE"] = ("1" if step_mode == "donate"
-                                      else "0")
 
+def _mk_batch(jnp, keys, **over):
+    """RequestBatch with bench-default columns (scalar-now serving
+    shape: the `now` column is 0 so _sustain's advancing scalar now
+    drives time)."""
     from gubernator_tpu.core.batch import RequestBatch
-    from gubernator_tpu.core.table import init_table
-    from gubernator_tpu.gregorian import gregorian_expiration
-    from gubernator_tpu.types import Behavior, GregorianDuration
 
     i64, i32 = jnp.int64, jnp.int32
-    out = {}
+    B2 = keys.shape[0]
+    cols = dict(
+        hits=jnp.ones(B2, i64), limit=jnp.full(B2, LIMIT, i64),
+        duration=jnp.full(B2, DURATION_MS, i64),
+        eff_ms=jnp.full(B2, DURATION_MS, i64),
+        greg_end=jnp.zeros(B2, i64), behavior=jnp.zeros(B2, i32),
+        algorithm=jnp.zeros(B2, i32), burst=jnp.full(B2, LIMIT, i64),
+        valid=jnp.ones(B2, bool),
+        now=jnp.zeros(B2, i64))
+    cols.update(over)
+    return RequestBatch(key=jnp.asarray(keys), **cols)
 
-    def _ck():
-        if checkpoint is not None:
-            checkpoint(dict(out))
+
+def _make_reqs(rng, name="svc"):
+    """4 batches × 1000 Zipf-keyed RateLimitRequests.  Sections that
+    must serve the SAME workload (svc object lane vs its wire lane;
+    the cluster row vs round-2's recorded numbers) all draw these from
+    a fresh seed-7 rng, so the bytes are identical across sections and
+    rounds."""
+    from gubernator_tpu.types import RateLimitRequest
+
+    return [[RateLimitRequest(name=name, unique_key=f"k{int(k)}",
+                              hits=1, limit=100, duration=60_000)
+             for k in rng.zipf(ZIPF_A, size=1000) % 100_000]
+            for _ in range(4)]
+
+
+def _serialize_reqs(reqs_lists):
+    """[[RateLimitRequest]] → serialized GetRateLimitsReq bytes."""
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.wire import req_to_pb
+
+    datas = []
+    for rs in reqs_lists:
+        m = pb.GetRateLimitsReq()
+        m.requests.extend(req_to_pb(r) for r in rs)
+        datas.append(m.SerializeToString())
+    return datas
+
+
+def _sec_lat_client():
+    """Client-shaped device latency: one 1024-row batch per synced call
+    (the p99<2ms target's shape) over a CAP-sized table."""
+    import jax.numpy as jnp
+
+    from gubernator_tpu.core.step import decide_batch, decide_batch_donated
+    from gubernator_tpu.core.table import init_table
+
+    step = (decide_batch_donated
+            if os.environ.get("GUBER_BENCH_STEP_MODE") == "donate"
+            else decide_batch)
+    i64 = jnp.int64
+    rng = np.random.default_rng(42)
+    Bc = 1024
+    keys = _keyhash((rng.zipf(ZIPF_A, size=Bc) % N_KEYS).astype(np.uint64))
+    small = _mk_batch(jnp, keys)
+    state = init_table(CAP)
+    state, outc = step(state, small, jnp.asarray(NOW0, i64))
+    outc.status.block_until_ready()
+    lats = []
+    for i in range(100):
+        t0 = time.perf_counter()
+        state, outc = step(state, small, jnp.asarray(NOW0 + 1 + i, i64))
+        outc.status.block_until_ready()
+        lats.append((time.perf_counter() - t0) * 1e3)
+    return {"client_batch_p50_ms": round(float(np.percentile(lats, 50)), 3),
+            "client_batch_p99_ms": round(float(np.percentile(lats, 99)), 3)}
+
+
+def _sec_cfg12():
+    """Configs 1+2: single-key TOKEN smoke (the duplicate-segment worst
+    case) and LEAKY 1k keys."""
+    import jax.numpy as jnp
+
+    from gubernator_tpu.core.step import decide_batch
+    from gubernator_tpu.core.table import init_table
+
+    i64, i32 = jnp.int64, jnp.int32
     rng = np.random.default_rng(7)
-
-    def mk(keys, **over):
-        B2 = keys.shape[0]
-        cols = dict(
-            hits=jnp.ones(B2, i64), limit=jnp.full(B2, LIMIT, i64),
-            duration=jnp.full(B2, DURATION_MS, i64),
-            eff_ms=jnp.full(B2, DURATION_MS, i64),
-            greg_end=jnp.zeros(B2, i64), behavior=jnp.zeros(B2, i32),
-            algorithm=jnp.zeros(B2, i32), burst=jnp.full(B2, LIMIT, i64),
-            valid=jnp.ones(B2, bool),
-            # 0 = use the step's scalar now argument (these configs
-            # advance time per call through _sustain)
-            now=jnp.zeros(B2, i64))
-        cols.update(over)
-        return RequestBatch(key=jnp.asarray(keys), **cols)
-
-    # -- config 1: single key, TOKEN_BUCKET (examples_test.go smoke).
-    # Every request in the batch is the same key: the worst case for the
-    # duplicate-segment path (one segment of length B).
+    out = {}
+    Bs = 4096
     try:
-        Bs = 4096
         keys1 = np.full(Bs, 12345, np.uint64)
         st = init_table(1 << 12)
-        b = mk(keys1, limit=jnp.full(Bs, 10**9, i64))
+        b = _mk_batch(jnp, keys1, limit=jnp.full(Bs, 10**9, i64))
         st, _ = decide_batch(st, b, jnp.asarray(NOW0, i64))  # compile
         dps1, _ = _sustain(decide_batch, jnp, st, [b], 20, NOW0 + 1)
         out["1_single_key_smoke"] = {"decisions_per_s": round(dps1)}
     except Exception as e:  # noqa: BLE001
         out["1_single_key_smoke"] = {"error": str(e)[:200]}
-
-    _ck()
-    # -- config 2: LEAKY_BUCKET, 1k keys uniform.
     try:
         keys2 = _keyhash(rng.integers(0, 1000, size=Bs).astype(np.uint64))
         st = init_table(1 << 12)
-        b2 = mk(keys2, algorithm=jnp.ones(Bs, i32),
-                limit=jnp.full(Bs, 10**6, i64),
-                burst=jnp.full(Bs, 10**6, i64),
-                duration=jnp.full(Bs, 60_000, i64),
-                eff_ms=jnp.full(Bs, 60_000, i64))
+        b2 = _mk_batch(jnp, keys2, algorithm=jnp.ones(Bs, i32),
+                       limit=jnp.full(Bs, 10**6, i64),
+                       burst=jnp.full(Bs, 10**6, i64),
+                       duration=jnp.full(Bs, 60_000, i64),
+                       eff_ms=jnp.full(Bs, 60_000, i64))
         st, _ = decide_batch(st, b2, jnp.asarray(NOW0, i64))
         dps2, _ = _sustain(decide_batch, jnp, st, [b2], 20, NOW0 + 1)
         out["2_leaky_1k_keys"] = {"decisions_per_s": round(dps2)}
     except Exception as e:  # noqa: BLE001
         out["2_leaky_1k_keys"] = {"error": str(e)[:200]}
+    return out
 
-    _ck()
-    # -- config 4: GLOBAL multi-peer ≙ sharded mesh step over all local
-    # devices (4-chip ICI on a pod; 1 chip here → measures shard_map
-    # overhead on the same program).
+
+def _sec_cfg4():
+    """Config 4: GLOBAL multi-peer ≙ sharded mesh step over all local
+    devices (4-chip ICI on a pod; 1 chip here → shard_map overhead)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.mesh import shard_table
+    from gubernator_tpu.parallel.sharded import make_sharded_step
+
+    i64 = jnp.int64
+    rng = np.random.default_rng(7)
+    mesh = make_mesh()
+    n = mesh.shape["shard"]
+    step = make_sharded_step(mesh)
+    stg = shard_table(mesh, 1 << 18)
+    Bg = 16384 * n
+    keysg = _keyhash(rng.zipf(ZIPF_A, size=Bg) % 100_000)
+    bg = _mk_batch(jnp, keysg)
+    sh = NamedSharding(mesh, P("shard"))
+    bg = RequestBatch(*[jax.device_put(np.asarray(x), sh) for x in bg])
+    stg, o, _ = step(stg, bg, jnp.asarray(NOW0, i64))
+    t0 = time.perf_counter()
+    reps = 20
+    for r in range(reps):
+        stg, o, _ = step(stg, bg, jnp.asarray(NOW0 + 1 + r, i64))
+    o[0].block_until_ready()
+    dps4 = reps * Bg / (time.perf_counter() - t0)
+    return {"4_global_sharded": {"decisions_per_s": round(dps4),
+                                 "n_shards": int(n)}}
+
+
+def _sec_svc():
+    """Service path: full V1Instance routing + dispatcher + response
+    assembly (benchmark_test.go › BenchmarkServer_GetRateLimit analog),
+    its C++ wire lane, the 16-thread concurrent front door, and the
+    peer-forwarding apply path (BenchmarkServer_GetPeerRateLimit)."""
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(7)
+    out = {}
+    inst = V1Instance(Config(cache_size=1 << 16, sweep_interval_ms=0),
+                      mesh=make_mesh(n=1))
     try:
-        from gubernator_tpu.parallel import make_mesh
-        from gubernator_tpu.parallel.sharded import make_sharded_step
-        from gubernator_tpu.parallel.mesh import shard_table
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        mesh = make_mesh()
-        n = mesh.shape["shard"]
-        step = make_sharded_step(mesh)
-        stg = shard_table(mesh, 1 << 18)
-        Bg = 16384 * n
-        keysg = _keyhash(rng.zipf(ZIPF_A, size=Bg) % 100_000)
-        bg = mk(keysg)
-        sh = NamedSharding(mesh, P("shard"))
-        bg = RequestBatch(*[jax.device_put(np.asarray(x), sh) for x in bg])
-        stg, o, _ = step(stg, bg, jnp.asarray(NOW0, i64))
-        t0 = time.perf_counter()
-        reps = 20
-        for r in range(reps):
-            stg, o, _ = step(stg, bg, jnp.asarray(NOW0 + 1 + r, i64))
-        o[0].block_until_ready()
-        dps4 = reps * Bg / (time.perf_counter() - t0)
-        out["4_global_sharded"] = {"decisions_per_s": round(dps4),
-                                   "n_shards": int(n)}
-    except Exception as e:  # noqa: BLE001
-        out["4_global_sharded"] = {"error": str(e)[:200]}
-
-    _ck()
-    # -- service path: full V1Instance routing + dispatcher + response
-    # assembly (the analog of benchmark_test.go › BenchmarkServer_
-    # GetRateLimit: what a client sees per node, host costs included).
-    try:
-        from gubernator_tpu.config import Config
-        from gubernator_tpu.instance import V1Instance
-        from gubernator_tpu.parallel import make_mesh
-        from gubernator_tpu.types import RateLimitRequest
-
-        inst = V1Instance(Config(cache_size=1 << 16, sweep_interval_ms=0),
-                          mesh=make_mesh(n=1))
-        reqs5 = [[RateLimitRequest(name="svc", unique_key=f"k{int(k)}",
-                                   hits=1, limit=100, duration=60_000)
-                  for k in rng.zipf(ZIPF_A, size=1000) % 100_000]
-                 for _ in range(4)]
+        reqs5 = _make_reqs(rng)
         inst.get_rate_limits(reqs5[0], now_ms=NOW0)
         t0 = time.perf_counter()
         reps = 20
@@ -497,14 +542,9 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
         # the C++ wire lane (bytes → columns → device → bytes), the
         # path a gRPC client actually exercises
         try:
-            from gubernator_tpu.proto import gubernator_pb2 as pb
-            from gubernator_tpu.wire import req_to_pb
-
-            datas = []
-            for rs in reqs5:
-                m = pb.GetRateLimitsReq()
-                m.requests.extend(req_to_pb(r) for r in rs)
-                datas.append(m.SerializeToString())
+            # same 4000 requests through the wire lane as through the
+            # object lane above — both lanes serve identical batches
+            datas = _serialize_reqs(reqs5)
             inst.get_rate_limits_wire(datas[0], now_ms=NOW0 + 100)
             t0 = time.perf_counter()
             for r in range(reps):
@@ -514,7 +554,6 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
                 reps * 1000 / (time.perf_counter() - t0))
             # service-layer latency at the client-batch shape (the
             # p99 < 2 ms target's request): bytes → decisions → bytes
-            # through the full V1Instance wire lane
             lat = []
             for r in range(60):
                 t0 = time.perf_counter()
@@ -529,7 +568,6 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
             out["6_service_path"]["wire_lane_error"] = str(e)[:200]
         # concurrent front door: 16 caller threads through the full
         # wire lane — the dispatcher coalesces them into shared waves
-        # (wave_buckets), which is what a loaded gRPC server does
         try:
             import threading as _th
 
@@ -554,11 +592,11 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
                 n_threads * reps_c * 1000 / (time.perf_counter() - t0))
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["concurrent_error"] = str(e)[:200]
-        # peer-forwarding path (benchmark_test.go ›
-        # BenchmarkServer_GetPeerRateLimit analog): the owner-side
-        # apply a forwarded batch takes, via its wire lane
+        # peer-forwarding path: what the owner-side apply of a
+        # forwarded batch takes, via its wire lane
         try:
             from gubernator_tpu.proto import peers_pb2 as peers_pb
+            from gubernator_tpu.wire import req_to_pb
 
             pdatas = []
             for rs in reqs5:
@@ -576,194 +614,179 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
                 "batch": 1000}
         except Exception as e:  # noqa: BLE001
             out["8_peer_path"] = {"error": str(e)[:200]}
+    finally:
         inst.close()
-    except Exception as e:  # noqa: BLE001
-        out["6_service_path"] = {"error": str(e)[:200]}
+    return out
 
-    _ck()
-    # -- clustered service path (VERDICT r1 item 4's bench criterion):
-    # client-facing GetRateLimits through daemon 0 of a real 3-daemon
-    # loopback cluster, keys ring-split across owners, forwards riding
-    # the raw-TLV peer wire — the number a clustered deployment sees.
+
+def _sec_cluster():
+    """Clustered service path (VERDICT r1 item 4's bench criterion):
+    client-facing GetRateLimits through daemon 0 of a real 3-daemon
+    loopback cluster, keys ring-split across owners, forwards riding
+    the raw-TLV peer wire."""
+    from gubernator_tpu import cluster as cluster_mod
+
+    # identical bytes to the svc section's wire batches (fresh seed-7
+    # rng draws the same keys), preserving round-2 comparability
+    datas = _serialize_reqs(_make_reqs(np.random.default_rng(7)))
+    c3 = cluster_mod.start(3, cache_size=1 << 14, batch_rows=1024)
     try:
-        from gubernator_tpu import cluster as cluster_mod
-        from gubernator_tpu.proto import gubernator_pb2 as pb2c
+        inst0 = c3.instance_at(0)
+        reps = 12
+        inst0.get_rate_limits_wire(datas[0], now_ms=NOW0 + 300)
+        t0 = time.perf_counter()
+        for r in range(reps):
+            inst0.get_rate_limits_wire(datas[r % 4],
+                                       now_ms=NOW0 + 301 + r)
+        dps_c3 = reps * 1000 / (time.perf_counter() - t0)
+        lane = inst0.metrics.wire_lane_counter.labels(
+            lane="wire_clustered")._value.get()
+        return {"9_clustered_service": {
+            "decisions_per_s": round(dps_c3), "daemons": 3,
+            "wire_clustered_requests": int(lane)}}
+    finally:
+        c3.stop()
 
-        c3 = cluster_mod.start(3, cache_size=1 << 14, batch_rows=1024)
-        try:
-            inst0 = c3.instance_at(0)
-            reps = 12
-            inst0.get_rate_limits_wire(datas[0], now_ms=NOW0 + 300)
-            t0 = time.perf_counter()
-            for r in range(reps):
-                inst0.get_rate_limits_wire(datas[r % 4],
-                                           now_ms=NOW0 + 301 + r)
-            dps_c3 = reps * 1000 / (time.perf_counter() - t0)
-            lane = inst0.metrics.wire_lane_counter.labels(
-                lane="wire_clustered")._value.get()
-            out["9_clustered_service"] = {
-                "decisions_per_s": round(dps_c3), "daemons": 3,
-                "wire_clustered_requests": int(lane)}
-        finally:
-            c3.stop()
-    except Exception as e:  # noqa: BLE001
-        out["9_clustered_service"] = {"error": str(e)[:200]}
 
-    _ck()
-    # -- SO_REUSEPORT front-door group (VERDICT r1 item 5): N daemon
-    # PROCESSES share one client gRPC port; kernel spreads connections;
-    # keys ring-split across per-process engines with raw-TLV peer
-    # forwards.  This is the aggregate host throughput a one-machine
-    # deployment front door actually delivers — real sockets, real
-    # serialization, every GIL boundary included.  Runs on the CPU
-    # backend by design (subprocesses can't share the TPU chip; on a
-    # TPU host these are the ingest workers).
+def _sec_group():
+    """SO_REUSEPORT front-door group (VERDICT r1 item 5): N daemon
+    PROCESSES share one client gRPC port; kernel spreads connections;
+    keys ring-split across per-process engines with raw-TLV peer
+    forwards.  Runs on the CPU backend by design (subprocesses can't
+    share the TPU chip; on a TPU host these are the ingest workers).
+    Needs ≥4 host cores — on fewer the row self-skips honestly
+    (measured 1-core thrash: 18k/s aggregate, p99 25 s)."""
     host_cores = len(os.sched_getaffinity(0)) if hasattr(
         os, "sched_getaffinity") else (os.cpu_count() or 1)
     if os.environ.get("GUBER_BENCH_SKIP_GROUP"):
-        pass
-    elif host_cores < 4:
-        # process-level scaling needs cores to scale over: on a 1-2
-        # core host N JAX processes thrash the scheduler (measured:
-        # 18k/s aggregate, p99 25s on 1 core) — an honest skip beats a
-        # garbage number.  The per-process ceiling is measured by
-        # 6_service_path's concurrent row.
-        out["10_reuseport_group"] = {
+        return {}
+    if host_cores < 4:
+        return {"10_reuseport_group": {
             "skipped": f"host has {host_cores} core(s); the SO_REUSEPORT "
                        "group measures process-level front-door scaling "
-                       "and needs >=4"}
-    else:
-        try:
-            import threading as _th
+                       "and needs >=4"}}
+    import threading as _th
 
-            import grpc as _grpc
+    import grpc as _grpc
 
-            from gubernator_tpu.cluster import start_subprocess_group
-            from gubernator_tpu.proto import gubernator_pb2 as pb_g
-            from gubernator_tpu.types import RateLimitRequest
-            from gubernator_tpu.wire import req_to_pb as req_to_pb_g
+    from gubernator_tpu.cluster import start_subprocess_group
 
-            # self-contained request batches: this row must not depend
-            # on 6_service_path's locals surviving
-            gdatas = []
-            for _ in range(4):
-                mm = pb_g.GetRateLimitsReq()
-                mm.requests.extend(req_to_pb_g(RateLimitRequest(
-                    name="grp", unique_key=f"k{int(k)}", hits=1,
-                    limit=100, duration=60_000))
-                    for k in rng.zipf(ZIPF_A, size=1000) % 100_000)
-                gdatas.append(mm.SerializeToString())
-
-            n_procs = 2 if FAST else min(4, host_cores)
-            grp = start_subprocess_group(n_procs, cache_size=1 << 16,
-                                         batch_rows=1024)
-            chans = []
-            try:
-                n_chan, reps_g = 4 * n_procs, 40
-                chans = [_grpc.insecure_channel(
-                    grp.client_address,
-                    options=[("grpc.use_local_subchannel_pool", 1)])
-                    for _ in range(n_chan)]
-                calls = [c.unary_unary("/pb.gubernator.V1/GetRateLimits")
-                         for c in chans]
-                # connect + warmup: timed traffic reuses these same
-                # connections, and each warmup batch ring-forwards
-                # sub-batches to EVERY process, so every engine has
-                # compiled its wave program before timing starts
-                for call in calls:
-                    call(gdatas[0], timeout=60)
-                lat_g = [[] for _ in range(n_chan)]
-
-                g_errors = []
-
-                def _gworker(t):
-                    try:
-                        for r in range(reps_g):
-                            t1 = time.perf_counter()
-                            calls[t](gdatas[(t + r) % 4], timeout=60)
-                            lat_g[t].append((time.perf_counter() - t1) * 1e3)
-                    except Exception as e2:  # noqa: BLE001
-                        g_errors.append(str(e2)[:120])
-
-                ths = [_th.Thread(target=_gworker, args=(t,))
-                       for t in range(n_chan)]
-                t0 = time.perf_counter()
-                for th in ths:
-                    th.start()
-                for th in ths:
-                    th.join()
-                wall = time.perf_counter() - t0
-                # numerator = calls that actually completed: a daemon
-                # dying mid-run must not inflate the rate
-                flat = [x for ls in lat_g for x in ls]
-                row = {
-                    "decisions_per_s": round(len(flat) * 1000 / wall),
-                    "processes": n_procs, "connections": n_chan}
-                if flat:
-                    row["p50_ms"] = round(float(np.percentile(flat, 50)), 3)
-                    row["p99_ms"] = round(float(np.percentile(flat, 99)), 3)
-                if g_errors:
-                    row["worker_errors"] = g_errors[:3]
-                out["10_reuseport_group"] = row
-            finally:
-                for c in chans:
-                    try:
-                        c.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-                grp.stop()
-        except Exception as e:  # noqa: BLE001
-            out["10_reuseport_group"] = {"error": str(e)[:200]}
-
-    _ck()
-    # -- hot-set psum tier: replica-local GLOBAL decisions + one psum
-    # fold per sync (the north-star replacement for global.go).
+    gdatas = _serialize_reqs(_make_reqs(np.random.default_rng(7),
+                                        name="grp"))
+    n_procs = 2 if FAST else min(4, host_cores)
+    grp = start_subprocess_group(n_procs, cache_size=1 << 16,
+                                 batch_rows=1024)
+    chans = []
     try:
-        from gubernator_tpu.hashing import hash_key
-        from gubernator_tpu.parallel import HotSetEngine, make_mesh
-        from gubernator_tpu.types import RateLimitRequest
+        n_chan, reps_g = 4 * n_procs, 40
+        chans = [_grpc.insecure_channel(
+            grp.client_address,
+            options=[("grpc.use_local_subchannel_pool", 1)])
+            for _ in range(n_chan)]
+        calls = [c.unary_unary("/pb.gubernator.V1/GetRateLimits")
+                 for c in chans]
+        # connect + warmup: timed traffic reuses these same
+        # connections, and each warmup batch ring-forwards sub-batches
+        # to EVERY process, so every engine has compiled its wave
+        # program before timing starts
+        for call in calls:
+            call(gdatas[0], timeout=60)
+        lat_g = [[] for _ in range(n_chan)]
+        g_errors = []
 
-        mesh = make_mesh()
-        hot = HotSetEngine(mesh, capacity=1024, batch_per_chip=2048)
-        n = hot.n
-        hreq = RateLimitRequest(name="hot", unique_key="k", hits=1,
-                                limit=10**9, duration=600_000)
-        hkh = hash_key("hot", "k")
-        hot.pin(hreq, hkh, NOW0)
-        wave = [hreq] * (n * 2048)
-        khs = [hkh] * len(wave)
-        hot.check_batch(wave, khs, NOW0)  # compile
+        def _gworker(t):
+            try:
+                for r in range(reps_g):
+                    t1 = time.perf_counter()
+                    calls[t](gdatas[(t + r) % 4], timeout=60)
+                    lat_g[t].append((time.perf_counter() - t1) * 1e3)
+            except Exception as e2:  # noqa: BLE001
+                g_errors.append(str(e2)[:120])
+
+        ths = [_th.Thread(target=_gworker, args=(t,))
+               for t in range(n_chan)]
         t0 = time.perf_counter()
-        reps = 10
-        for r in range(reps):
-            hot.check_batch(wave, khs, NOW0 + 1 + r)
-        dps_hot = reps * len(wave) / (time.perf_counter() - t0)
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        wall = time.perf_counter() - t0
+        # numerator = calls that actually completed: a daemon dying
+        # mid-run must not inflate the rate
+        flat = [x for ls in lat_g for x in ls]
+        row = {"decisions_per_s": round(len(flat) * 1000 / wall),
+               "processes": n_procs, "connections": n_chan}
+        if flat:
+            row["p50_ms"] = round(float(np.percentile(flat, 50)), 3)
+            row["p99_ms"] = round(float(np.percentile(flat, 99)), 3)
+        if g_errors:
+            row["worker_errors"] = g_errors[:3]
+        return {"10_reuseport_group": row}
+    finally:
+        for c in chans:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        grp.stop()
+
+
+def _sec_hot():
+    """Hot-set psum tier: replica-local GLOBAL decisions + one psum
+    fold per sync (the north-star replacement for global.go)."""
+    import jax
+
+    from gubernator_tpu.hashing import hash_key
+    from gubernator_tpu.parallel import HotSetEngine, make_mesh
+    from gubernator_tpu.types import RateLimitRequest
+
+    mesh = make_mesh()
+    hot = HotSetEngine(mesh, capacity=1024, batch_per_chip=2048)
+    n = hot.n
+    hreq = RateLimitRequest(name="hot", unique_key="k", hits=1,
+                            limit=10**9, duration=600_000)
+    hkh = hash_key("hot", "k")
+    hot.pin(hreq, hkh, NOW0)
+    wave = [hreq] * (n * 2048)
+    khs = [hkh] * len(wave)
+    hot.check_batch(wave, khs, NOW0)  # compile
+    t0 = time.perf_counter()
+    reps = 10
+    for r in range(reps):
+        hot.check_batch(wave, khs, NOW0 + 1 + r)
+    dps_hot = reps * len(wave) / (time.perf_counter() - t0)
+    hot.sync()
+    jax.block_until_ready(hot.state)
+    t0 = time.perf_counter()
+    for _ in range(20):
         hot.sync()
-        jax.block_until_ready(hot.state)
-        t0 = time.perf_counter()
-        for _ in range(20):
-            hot.sync()
-        jax.block_until_ready(hot.state)  # async dispatch: wait for the fold
-        sync_ms = (time.perf_counter() - t0) / 20 * 1e3
-        out["7_hot_psum"] = {"decisions_per_s": round(dps_hot),
-                             "sync_ms": round(sync_ms, 3),
-                             "n_replicas": int(n)}
-    except Exception as e:  # noqa: BLE001
-        out["7_hot_psum"] = {"error": str(e)[:200]}
+    jax.block_until_ready(hot.state)  # async dispatch: wait for the fold
+    sync_ms = (time.perf_counter() - t0) / 20 * 1e3
+    return {"7_hot_psum": {"decisions_per_s": round(dps_hot),
+                           "sync_ms": round(sync_ms, 3),
+                           "n_replicas": int(n)}}
 
-    _ck()
-    # -- config 5: huge multi-tenant table (100M keys → CAP 2^27),
-    # Gregorian resets + RESET_REMAINING churn.  The TRUE BASELINE.json
-    # capacity is attempted — never silently downscaled (VERDICT r1
-    # item 3): the donated step keeps ONE copy of the ~9 GB table live
-    # (in-place/pass-through updates), which is what makes 2^27 fit a
-    # 16 GB chip at all.  A failure (OOM, lowering) is recorded as an
-    # error row, honestly.  The CPU fallback uses a reduced capacity and
-    # says so via "cpu_reduced".
+
+def _sec_cfg5():
+    """Config 5: huge multi-tenant table (100M keys → CAP 2^27),
+    Gregorian resets + RESET_REMAINING churn.  The TRUE BASELINE.json
+    capacity is attempted — never silently downscaled (VERDICT r1
+    item 3): the donated step keeps ONE copy of the ~9 GB table live,
+    which is what makes 2^27 fit a 16 GB chip at all.  The CPU
+    fallback uses a reduced capacity and says so via "cpu_reduced"."""
+    import jax
+    import jax.numpy as jnp
+
+    from gubernator_tpu.core.step import decide_batch_donated
+    from gubernator_tpu.core.table import init_table
+    from gubernator_tpu.gregorian import gregorian_expiration
+    from gubernator_tpu.types import Behavior, GregorianDuration
+
+    i64 = jnp.int64
+    rng = np.random.default_rng(7)
     cpu5 = jax.default_backend() == "cpu"
     cap5 = 1 << 22 if cpu5 else 1 << 27
     try:
-        from gubernator_tpu.core.step import decide_batch_donated
         n_keys5 = int(cap5 * 0.75)
         st5 = init_table(cap5)
         greg_end = gregorian_expiration(NOW0, int(GregorianDuration.HOURS))
@@ -772,9 +795,10 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
         for i in range(4):
             k = _keyhash(rng.integers(0, n_keys5, size=B).astype(np.uint64))
             beh_col = np.full(B, beh, np.int32)
-            beh_col[:: 37] |= int(Behavior.RESET_REMAINING)  # churn
-            batches.append(mk(
-                k, duration=jnp.full(B, int(GregorianDuration.HOURS), i64),
+            beh_col[::37] |= int(Behavior.RESET_REMAINING)  # churn
+            batches.append(_mk_batch(
+                jnp, k,
+                duration=jnp.full(B, int(GregorianDuration.HOURS), i64),
                 eff_ms=jnp.full(B, 3_600_000, i64),
                 greg_end=jnp.full(B, greg_end, i64),
                 behavior=jnp.asarray(beh_col)))
@@ -782,12 +806,175 @@ def run_secondary_configs(jnp, decide_batch, const_proto,
                                       jnp.asarray(NOW0, i64))
         dps5, _ = _sustain(decide_batch_donated, jnp, st5, batches, 16,
                            NOW0 + 1)
-        out["5_gregorian_churn"] = {"decisions_per_s": round(dps5),
-                                    "capacity": cap5,
-                                    "cpu_reduced": cpu5}
+        return {"5_gregorian_churn": {"decisions_per_s": round(dps5),
+                                      "capacity": cap5,
+                                      "cpu_reduced": cpu5}}
     except Exception as e:  # noqa: BLE001
-        out["5_gregorian_churn"] = {"error": str(e)[:200],
-                                    "capacity_attempted": int(cap5)}
+        return {"5_gregorian_churn": {"error": str(e)[:200],
+                                      "capacity_attempted": int(cap5)}}
+
+
+#: section name → (callable, result row keys for skip/error reporting)
+_SECTIONS = {
+    "lat_client": (_sec_lat_client,
+                   ["client_batch_p50_ms", "client_batch_p99_ms"]),
+    "cfg12": (_sec_cfg12, ["1_single_key_smoke", "2_leaky_1k_keys"]),
+    "cfg4": (_sec_cfg4, ["4_global_sharded"]),
+    "svc": (_sec_svc, ["6_service_path", "8_peer_path"]),
+    "cluster": (_sec_cluster, ["9_clustered_service"]),
+    "group": (_sec_group, ["10_reuseport_group"]),
+    "hot": (_sec_hot, ["7_hot_psum"]),
+    "cfg5": (_sec_cfg5, ["5_gregorian_churn"]),
+}
+
+#: device sections that each pay a fresh compile, in run order
+_SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot", "cfg5"]
+
+_WEDGED = False  # set when a section timeout + failed device probe
+
+
+def _device_probe(timeout=150) -> bool:
+    """Trivial-op probe in a throwaway subprocess: the axon tunnel has
+    repeatedly been observed wedged such that backend init (or any new
+    compile) hangs forever — don't spend a full section timeout
+    discovering that.  A probe that answers with the CPU backend is a
+    FAILED device probe: jax fell back silently."""
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp;"
+            "jnp.arange(8).sum().block_until_ready();"
+            "print(jax.default_backend())")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=timeout, stdout=subprocess.PIPE,
+                           stderr=subprocess.PIPE)
+        backend = (r.stdout or b"").decode().strip()
+        ok = r.returncode == 0 and backend not in ("", "cpu")
+        log(f"device probe: ok={ok} backend={backend!r}")
+        if r.returncode != 0:
+            tail = (r.stderr or b"").decode(errors="replace")[-400:]
+            log(f"device probe stderr tail: {tail}")
+        return ok
+    except Exception as e2:  # noqa: BLE001
+        log(f"device probe failed: {e2!r:.120} (tunnel wedged?)")
+        return False
+
+
+def _run_section(name, inline):
+    """Run one section; inline on CPU, in a child process on a device
+    backend (wedged compiles cost one row, not the run)."""
+    global _WEDGED
+    fn, _rows = _SECTIONS[name]
+    if inline:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{name}: {str(e)[:300]}"}
+    import subprocess
+
+    path = f"/tmp/guber_section.{os.getpid()}.{name}.json"
+    env = dict(os.environ, GUBER_BENCH_SECTION=name,
+               GUBER_BENCH_SECTION_OUT=path)
+    env.pop("GUBER_BENCH_INNER", None)
+    try:
+        import jax
+
+        env["GUBER_BENCH_EXPECT_BACKEND"] = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        pass
+    # worst observed tunnel compile is ~305 s; 3× margin keeps one
+    # wedged section + the follow-up probe well inside the watchdog's
+    # whole-run deadline even on a cold cache (see _watchdog_main)
+    timeout = int(os.environ.get(
+        "GUBER_BENCH_SECTION_TIMEOUT",
+        "1200" if name == "cfg5" else "900"))
+    t0 = time.perf_counter()
+    try:
+        subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=timeout,
+                       stdout=subprocess.DEVNULL)
+        with open(path) as f:
+            rows = json.load(f)
+        log(f"[{name}] section done in {time.perf_counter() - t0:.1f}s")
+        return rows
+    except subprocess.TimeoutExpired:
+        log(f"[{name}] section timed out after {timeout}s — probing link")
+        if not _device_probe():
+            _WEDGED = True
+        return {"error": f"section timed out after {timeout}s "
+                         "(wedged device compile?)"}
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{name}: {str(e)[:300]}"}
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def _section_main():
+    """Child entry: run one section and write its rows atomically."""
+    plat = os.environ.get("GUBER_JAX_PLATFORM", "")
+    import jax
+
+    if plat:
+        # through jax.config: the sandbox sitecustomize overwrites the
+        # jax_platforms config at interpreter start (env is ignored)
+        jax.config.update("jax_platforms", plat)
+    name = os.environ["GUBER_BENCH_SECTION"]
+    fn, _rows = _SECTIONS[name]
+    # a child whose backend init silently fell back to CPU must NOT
+    # record its rates as device rows under the parent's backend label
+    expect = os.environ.get("GUBER_BENCH_EXPECT_BACKEND", "")
+    got = jax.default_backend()
+    if expect and got != expect:
+        rows = {"error": f"{name}: child backend is {got!r}, parent "
+                         f"expected {expect!r} (silent fallback — row "
+                         "dropped rather than mislabeled)"}
+    else:
+        try:
+            rows = fn()
+        except Exception as e:  # noqa: BLE001
+            rows = {"error": f"{name}: {str(e)[:300]}"}
+    path = os.environ["GUBER_BENCH_SECTION_OUT"]
+    with open(path + ".tmp", "w") as f:
+        json.dump(rows, f)
+    os.replace(path + ".tmp", path)
+
+
+def run_secondary_configs(step_mode, backend, checkpoint=None):
+    """BASELINE.md configs 1/2/4/5 (config 3 is the headline above)
+    plus the service/cluster/group/hot rows.  Smaller rep counts —
+    these document shape coverage, not the record.  ``checkpoint(out)``
+    runs after each section so rows measured before a late-stage
+    device failure survive (see _write_partial)."""
+    # serving engines in the sections read this at construction: they
+    # must run the mode that won — set it explicitly BOTH ways so a
+    # pre-existing operator export can't make the rows measure a
+    # different mode than reported (children inherit it)
+    os.environ["GUBER_STEP_DONATE"] = ("1" if step_mode == "donate"
+                                      else "0")
+    os.environ["GUBER_BENCH_STEP_MODE"] = step_mode
+    inline = backend == "cpu"
+    out = {}
+    for name in _SECTION_ORDER:
+        fn, row_keys = _SECTIONS[name]
+        # the group section never compiles on the device in-parent (it
+        # spawns CPU worker processes), so it is safe inline everywhere
+        sec_inline = inline or name == "group"
+        if _WEDGED and not sec_inline:
+            for k in row_keys:
+                out[k] = {"skipped": "device link wedged in an earlier "
+                                     "section; probe failed"}
+        else:
+            rows = _run_section(name, inline=sec_inline)
+            if "error" in rows and len(rows) == 1:
+                for k in row_keys:
+                    out[k] = {"error": rows["error"]}
+            else:
+                out.update(rows)
+        if checkpoint is not None:
+            checkpoint(dict(out))
     return out
 
 
@@ -800,8 +987,14 @@ def _watchdog_main():
     """
     import subprocess
 
-    # two headline compiles (copy + donated) can both be cold on TPU
-    deadline = int(os.environ.get("GUBER_BENCH_TIMEOUT", "4500"))
+    # Budget: two cold headline compiles (~300 s each) + scan/link/
+    # latency + up to 8 section children, each paying backend init and
+    # possibly a cold compile (~250-330 s/section on a cold cache), and
+    # at most ONE wedged section (900-1200 s timeout + 150 s probe —
+    # after a failed probe the remaining device sections are skipped).
+    # Cold-cache worst case ≈ 600+400+8×330+1350 ≈ 5000 s; warm-cache
+    # runs finish in a fraction of that.
+    deadline = int(os.environ.get("GUBER_BENCH_TIMEOUT", "5400"))
     env = dict(os.environ, GUBER_BENCH_INNER="1")
     # per-run checkpoint file: a concurrent bench on the same host must
     # not be able to cross-salvage (or permission-break) our checkpoint
@@ -849,34 +1042,7 @@ def _watchdog_main():
         except (OSError, ValueError, KeyError):
             return None
 
-    def device_probe(timeout=150) -> bool:
-        """Trivial-op probe in a throwaway subprocess: the axon tunnel
-        has repeatedly been observed wedged such that backend init
-        hangs forever — don't spend the full deadline discovering
-        that.  (150 s covers a healthy cold init + trivial compile many
-        times over; this mirrors the probe protocol in ROUND_NOTES.)
-        A probe that answers with the CPU backend is a FAILED device
-        probe: jax fell back silently, and running the device-sized
-        workload there would burn the deadline and mislabel the rows."""
-        code = ("import jax, jax.numpy as jnp;"
-                "jnp.arange(8).sum().block_until_ready();"
-                "print(jax.default_backend())")
-        try:
-            r = subprocess.run([sys.executable, "-c", code],
-                               timeout=timeout, stdout=subprocess.PIPE,
-                               stderr=subprocess.PIPE)
-            backend = (r.stdout or b"").decode().strip()
-            ok = r.returncode == 0 and backend not in ("", "cpu")
-            log(f"device probe: ok={ok} backend={backend!r}")
-            if r.returncode != 0:
-                tail = (r.stderr or b"").decode(errors="replace")[-400:]
-                log(f"device probe stderr tail: {tail}")
-            return ok
-        except Exception as e2:  # noqa: BLE001
-            log(f"device probe failed: {e2!r:.120} (tunnel wedged?)")
-            return False
-
-    if os.environ.get("GUBER_JAX_PLATFORM", "") == "cpu" or device_probe():
+    if os.environ.get("GUBER_JAX_PLATFORM", "") == "cpu" or _device_probe():
         out = attempt({}, deadline)
     else:
         log("skipping the device attempt: backend unreachable")
@@ -903,7 +1069,9 @@ def _watchdog_main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("GUBER_BENCH_INNER"):
+    if os.environ.get("GUBER_BENCH_SECTION"):
+        _section_main()
+    elif os.environ.get("GUBER_BENCH_INNER"):
         main()
     else:
         _watchdog_main()

@@ -1,0 +1,62 @@
+"""The bench section protocol is the driver's contract: each secondary
+config runs as a child process on device backends (bench.py ›
+_run_section / _section_main), so a wedged tunnel compile costs one row
+instead of the run.  Pin the child protocol itself on CPU: rows land in
+the output file atomically, errors are contained, and a child whose
+backend silently fell back refuses to mislabel its rows."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_section(name, tmp_path, extra_env=None, timeout=300):
+    out = str(tmp_path / f"sec_{name}.json")
+    env = dict(os.environ,
+               GUBER_JAX_PLATFORM="cpu",
+               GUBER_BENCH_SECTION=name,
+               GUBER_BENCH_SECTION_OUT=out,
+               GUBER_BENCH_FAST="1")
+    env.update(extra_env or {})
+    r = subprocess.run([sys.executable, BENCH], env=env, cwd=REPO,
+                       timeout=timeout, stdout=subprocess.PIPE,
+                       stderr=subprocess.PIPE)
+    assert r.returncode == 0, r.stderr.decode()[-500:]
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_section_child_writes_rows(tmp_path):
+    rows = _run_section("cfg12", tmp_path)
+    assert set(rows) == {"1_single_key_smoke", "2_leaky_1k_keys"}
+    for v in rows.values():
+        assert v.get("decisions_per_s", 0) > 0, rows
+
+
+def test_section_child_backend_mismatch_guard(tmp_path):
+    """A child that lands on a different backend than the parent
+    expected must produce an error row, not mislabeled numbers."""
+    rows = _run_section("cfg12", tmp_path,
+                        extra_env={"GUBER_BENCH_EXPECT_BACKEND": "tpu"})
+    assert set(rows) == {"error"}
+    assert "silent fallback" in rows["error"]
+
+
+def test_section_registry_covers_baseline_rows():
+    """Every BASELINE row key the orchestrator may need to error-fill
+    is declared by exactly one section."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    declared = [k for _, keys in bench._SECTIONS.values() for k in keys]
+    assert len(declared) == len(set(declared)), "duplicate row keys"
+    for row in ["1_single_key_smoke", "2_leaky_1k_keys",
+                "4_global_sharded", "5_gregorian_churn",
+                "6_service_path", "7_hot_psum", "8_peer_path",
+                "9_clustered_service", "10_reuseport_group"]:
+        assert row in declared, row
+    for name in bench._SECTION_ORDER:
+        assert name in bench._SECTIONS

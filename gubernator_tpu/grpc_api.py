@@ -96,16 +96,80 @@ def add_health_servicer(server: grpc.Server, instance) -> None:
     RPC).  Hand-rolled wire, matching the rest of this module: the
     request (field 1: service name) is accepted for any service and
     answered with the daemon's overall health; the response is field 1
-    varint ServingStatus (1 = SERVING, 2 = NOT_SERVING).  The streaming
-    ``Watch`` method is not served (probes poll ``Check``)."""
+    varint ServingStatus (1 = SERVING, 2 = NOT_SERVING).
+
+    ``Watch`` (server-streaming) emits the current status immediately,
+    then a new message on every status CHANGE — the stock health
+    server's contract, ended by client cancel/teardown.  A sync gRPC
+    server necessarily parks one worker thread per open stream (the
+    stock sync grpcio health servicer does too), so concurrent watchers
+    are CAPPED at 4 — beyond that Watch aborts RESOURCE_EXHAUSTED
+    (probes should poll Check) — and all watchers share ONE 1 Hz status
+    poller that reads the cheap ``instance.health_status()`` (async-
+    manager error state only; no device work, no metrics writes) and
+    broadcasts changes over a condition."""
+    import threading
+
+    def _status() -> bytes:
+        if hasattr(instance, "health_status"):
+            ok = instance.health_status() == "healthy"
+        else:  # pragma: no cover - legacy instance objects
+            ok = instance.health_check().status == "healthy"
+        return bytes([0x08, 0x01 if ok else 0x02])
 
     def check(request: bytes, context):
-        ok = instance.health_check().status == "healthy"
-        return bytes([0x08, 0x01 if ok else 0x02])
+        return _status()
+
+    cond = threading.Condition()
+    state = {"cur": None, "watchers": 0, "poller": False}
+    MAX_WATCHERS = 4
+
+    def _poller():
+        import time as _time
+
+        while True:
+            with cond:
+                if state["watchers"] == 0:
+                    state["poller"] = False
+                    return  # last watcher left; the next one restarts us
+            cur = _status()
+            with cond:
+                if cur != state["cur"]:
+                    state["cur"] = cur
+                    cond.notify_all()
+            _time.sleep(1.0)
+
+    def watch(request: bytes, context):
+        with cond:
+            if state["watchers"] >= MAX_WATCHERS:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              "too many health watchers; poll Check")
+            state["watchers"] += 1
+            if state["cur"] is None:
+                state["cur"] = _status()
+            if not state["poller"]:
+                state["poller"] = True
+                threading.Thread(target=_poller, daemon=True,
+                                 name="health-watch-poller").start()
+        last = None
+        try:
+            while context.is_active():
+                with cond:
+                    if state["cur"] != last:
+                        last = out = state["cur"]
+                    else:
+                        cond.wait(timeout=5.0)
+                        continue
+                yield out
+        finally:
+            with cond:
+                state["watchers"] -= 1
 
     handlers = {
         "Check": grpc.unary_unary_rpc_method_handler(
             check, request_deserializer=None, response_serializer=None),
+        "Watch": grpc.unary_stream_rpc_method_handler(
+            watch, request_deserializer=None, response_serializer=None),
     }
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler("grpc.health.v1.Health",

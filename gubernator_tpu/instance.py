@@ -1175,6 +1175,18 @@ class V1Instance:
 
     # ---- health / lifecycle --------------------------------------------
 
+    def health_status(self) -> str:
+        """Cheap liveness answer ("healthy"/"unhealthy") from the async
+        managers' last-error state alone — NO device work, no metrics
+        side effects.  For callers that poll (health Watch streams):
+        ``health_check`` additionally syncs a device occupancy count,
+        which must not run at poll frequency."""
+        if self.global_manager is not None and self.global_manager.last_error:
+            return "unhealthy"
+        if self.mr_manager is not None and self.mr_manager.last_error:
+            return "unhealthy"
+        return "healthy"
+
     def health_check(self) -> HealthCheckResponse:
         """reference: gubernator.go › HealthCheck — healthy + peer count,
         surfacing the last async replication error if any."""

@@ -203,6 +203,24 @@ def test_standard_grpc_health_protocol(mesh):
         # empty request (overall health) and a named service both serve
         assert call(b"", timeout=30) == b"\x08\x01"
         assert call(b"\x0a\x10pb.gubernator.V1", timeout=30) == b"\x08\x01"
+        # Watch (server-streaming): first message is the current status
+        # immediately; the stream stays open (no second message until a
+        # status change), ended by client cancel
+        watch = ch.unary_stream("/grpc.health.v1.Health/Watch")
+        stream = watch(b"", timeout=30)
+        assert next(stream) == b"\x08\x01"
+        # concurrent watchers are capped (thread-per-stream on a sync
+        # server): the 5th gets RESOURCE_EXHAUSTED instead of parking
+        # another worker thread forever
+        extra = [watch(b"", timeout=30) for _ in range(3)]
+        for s in extra:
+            assert next(s) == b"\x08\x01"
+        denied = watch(b"", timeout=30)
+        with pytest.raises(_grpc.RpcError) as ei:
+            next(denied)
+        assert ei.value.code() == _grpc.StatusCode.RESOURCE_EXHAUSTED
+        for s in [stream, *extra]:
+            s.cancel()
         ch.close()
     finally:
         d.close()

@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test proto bench daemon cluster lint native clean
+.PHONY: test proto bench tpu-session b-sweep daemon cluster lint native clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -13,6 +13,15 @@ proto:
 
 bench:
 	$(PY) bench.py
+
+# one-shot on-chip validation battery (run when a TPU is reachable)
+tpu-session:
+	$(PY) tools/tpu_session.py
+
+# headline-only device-batch sweep, e.g. make b-sweep B="131072 262144"
+B ?= 131072
+b-sweep:
+	$(PY) tools/b_sweep.py $(B)
 
 daemon:
 	$(PY) -m gubernator_tpu.cmd.daemon --config example.conf

@@ -201,43 +201,16 @@ def main():
     }
     _write_partial(result)
 
-    # device-resident superstep: lax.scan chains R batches in ONE launch,
-    # so per-launch dispatch latency (µs locally, ~0.5 ms over a
-    # tunneled link) amortizes across R×B decisions — the on-chip
-    # sustained rate, which is what N coalesced client batches see.
-    R = int(os.environ.get("GUBER_BENCH_SCAN", 16))
-    import jax as _jax
-    from jax import lax as _lax
-
-    from gubernator_tpu.core.step import decide_batch_impl
-
-    @_jax.jit
-    def decide_scan(st, keys_rb, now0):
-        def body(carry, x):
-            st, i = carry
-            b = RequestBatch(key=x, **const)
-            st, out = decide_batch_impl(st, b, now0 + i)
-            return (st, i + 1), out.status.sum()
-        (st, _), overs = _lax.scan(body, (st, jnp.asarray(0, i64)), keys_rb)
-        return st, overs
-
-    try:
-        keys_rb = jnp.stack(key_batches[:min(R, n_batches)] *
-                            (R // n_batches + 1))[:R]
-        st_s = init_table(CAP)
-        st_s, ov = decide_scan(st_s, keys_rb, jnp.asarray(NOW0, i64))
-        ov.block_until_ready()  # compile + warm
-        reps_s = max(1, int(30_000_000 / (R * B)))
-        t0 = time.perf_counter()
-        for r in range(reps_s):
-            st_s, ov = decide_scan(st_s, keys_rb,
-                                   jnp.asarray(NOW0 + 1000 + r * R, i64))
-        ov.block_until_ready()
-        dps_scan = reps_s * R * B / (time.perf_counter() - t0)
-        log(f"device-scan sustained: {dps_scan/1e6:.2f}M/s (R={R})")
-    except Exception as e:  # noqa: BLE001
-        dps_scan = 0.0
-        log(f"device-scan failed: {e!r:.200}")
+    # device-resident superstep (fresh compile — child-isolated on
+    # device backends so a wedged scan compile can't cost the link/
+    # latency rows below; see _sec_scan)
+    scan_rows = _run_section("scan", inline=(backend == "cpu"))
+    dps_scan = float(scan_rows.get("device_scan_decisions_per_s", 0.0))
+    if "error" in scan_rows:
+        log(f"device-scan section: {scan_rows['error']}")
+    else:
+        log(f"device-scan sustained: {dps_scan/1e6:.2f}M/s "
+            f"(R={scan_rows.get('scan_R')})")
 
     # link round-trip floor: a trivial op's dispatch→sync time.  On a
     # direct-attached chip this is ~50 µs; over the axon tunnel it is
@@ -443,6 +416,59 @@ def _sec_lat_client():
         lats.append((time.perf_counter() - t0) * 1e3)
     return {"client_batch_p50_ms": round(float(np.percentile(lats, 50)), 3),
             "client_batch_p99_ms": round(float(np.percentile(lats, 99)), 3)}
+
+
+def _sec_scan():
+    """Device-resident superstep: lax.scan chains R batches in ONE
+    launch, so per-launch dispatch latency (µs locally, ~0.5 ms over a
+    tunneled link) amortizes across R×B decisions — the on-chip
+    sustained rate, which is what N coalesced client batches see."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.core.step import decide_batch_impl
+    from gubernator_tpu.core.table import init_table
+
+    i64 = jnp.int64
+    R = int(os.environ.get("GUBER_BENCH_SCAN", 16))
+    rng = np.random.default_rng(42)
+    n_batches = 8
+    draws = rng.zipf(ZIPF_A, size=n_batches * B) % N_KEYS
+    kb = [jnp.asarray(_keyhash(draws[i * B:(i + 1) * B].astype(np.uint64)))
+          for i in range(n_batches)]
+    const = dict(
+        hits=jnp.ones(B, i64), limit=jnp.full(B, LIMIT, i64),
+        duration=jnp.full(B, DURATION_MS, i64),
+        eff_ms=jnp.full(B, DURATION_MS, i64),
+        greg_end=jnp.zeros(B, i64), behavior=jnp.zeros(B, jnp.int32),
+        algorithm=jnp.zeros(B, jnp.int32), burst=jnp.full(B, LIMIT, i64),
+        valid=jnp.ones(B, bool))
+
+    @jax.jit
+    def decide_scan(st, keys_rb, now0):
+        def body(carry, x):
+            st, i = carry
+            b = RequestBatch(key=x, **const)
+            st, out = decide_batch_impl(st, b, now0 + i)
+            return (st, i + 1), out.status.sum()
+        (st, _), overs = lax.scan(body, (st, jnp.asarray(0, i64)), keys_rb)
+        return st, overs
+
+    keys_rb = jnp.stack(kb[:min(R, n_batches)] * (R // n_batches + 1))[:R]
+    st_s = init_table(CAP)
+    st_s, ov = decide_scan(st_s, keys_rb, jnp.asarray(NOW0, i64))
+    ov.block_until_ready()  # compile + warm
+    reps_s = max(1, int(30_000_000 / (R * B)))
+    t0 = time.perf_counter()
+    for r in range(reps_s):
+        st_s, ov = decide_scan(st_s, keys_rb,
+                               jnp.asarray(NOW0 + 1000 + r * R, i64))
+    ov.block_until_ready()
+    dps_scan = reps_s * R * B / (time.perf_counter() - t0)
+    return {"device_scan_decisions_per_s": round(dps_scan),
+            "scan_R": R}
 
 
 def _sec_cfg12():
@@ -818,6 +844,7 @@ def _sec_cfg5():
 _SECTIONS = {
     "lat_client": (_sec_lat_client,
                    ["client_batch_p50_ms", "client_batch_p99_ms"]),
+    "scan": (_sec_scan, ["device_scan_decisions_per_s"]),
     "cfg12": (_sec_cfg12, ["1_single_key_smoke", "2_leaky_1k_keys"]),
     "cfg4": (_sec_cfg4, ["4_global_sharded"]),
     "svc": (_sec_svc, ["6_service_path", "8_peer_path"]),

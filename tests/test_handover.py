@@ -144,3 +144,53 @@ def test_handover_preserves_30day_leaky_fixed_point(mesh):
         d1.close()
         if d2 is not None:
             d2.close()
+
+
+def test_gossip_join_triggers_handover(mesh):
+    """End-to-end elasticity: a second daemon joins via GOSSIP discovery
+    (no manual SetPeers), membership propagates over UDP heartbeats,
+    both daemons rebuild their rings, and — with handover enabled — the
+    rows whose ring owner moved arrive at the joiner with their
+    consumption intact.  This is the reference's memberlist-driven
+    SetPeers flow (memberlist.go › MemberListPool → SetPeers) composed
+    with the beyond-reference stateful re-shard."""
+    def mk_gossip_daemon(seeds):
+        return spawn_daemon(DaemonConfig(
+            grpc_listen_address=f"127.0.0.1:{free_port()}",
+            http_listen_address="",
+            cache_size=1 << 10,
+            handover_on_reshard=True,
+            peer_discovery_type="member-list",
+            memberlist_known_hosts=seeds), mesh=mesh)
+
+    d1 = mk_gossip_daemon([])
+    d2 = None
+    try:
+        with Client(f"127.0.0.1:{d1.grpc_port}") as c:
+            rs = c.get_rate_limits([req(i, hits=3) for i in range(N_KEYS)])
+            assert all(r.error == "" for r in rs)
+            assert {r.remaining for r in rs} == {7}
+        # join via gossip only: seed = d1's gossip bind (grpc port + 1)
+        d2 = mk_gossip_daemon([f"127.0.0.1:{d1.grpc_port + 1}"])
+        deadline = time.time() + 40
+        vals = []
+        while time.time() < deadline:
+            # membership must converge to 2 on both daemons...
+            if (len(d1.instance.peers()) == 2
+                    and len(d2.instance.peers()) == 2):
+                vals = [_remaining_via(d1, i) for i in range(N_KEYS)]
+                # ...and every key must still read 7 (handover, not
+                # reset) no matter which daemon now owns it
+                if all(v == 7 for v in vals):
+                    break
+            time.sleep(0.3)
+        assert len(d1.instance.peers()) == 2, "gossip never converged"
+        assert all(v == 7 for v in vals), vals
+        # the joiner genuinely owns some rows now
+        from gubernator_tpu.core.table import occupancy
+
+        assert int(occupancy(d2.instance.engine.state)) > 0
+    finally:
+        d1.close()
+        if d2 is not None:
+            d2.close()

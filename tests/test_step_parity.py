@@ -228,3 +228,38 @@ class TestRandomizedParity:
             batches.append((reqs, now))
             now += 3_000
         assert_parity(batches)
+
+
+def test_donated_step_matches_copy_step():
+    """The SERVING default (decide_batch_donated: same impl, table
+    donated in/out) must produce outputs and final state bit-identical
+    to the non-donated step on the same stream — guards against any
+    aliasing misuse at the call boundary (a donated input is dead after
+    the call; nothing may re-read it)."""
+    from gubernator_tpu.core.step import decide_batch_donated
+    from gubernator_tpu.core.table import TableState
+
+    rng = np.random.default_rng(3)
+    stc = init_table(1 << 12)
+    std = init_table(1 << 12)
+    for step_i in range(6):
+        reqs = [RateLimitRequest(
+            name="dm", unique_key=f"k{int(k)}",
+            hits=int(rng.integers(0, 3)), limit=20, duration=60_000,
+            algorithm=Algorithm.LEAKY_BUCKET if k % 3 == 0
+            else Algorithm.TOKEN_BUCKET,
+            behavior=Behavior.RESET_REMAINING if k % 17 == 0
+            else Behavior.BATCHING)
+            for k in rng.integers(0, 60, size=128)]
+        now = NOW + step_i * 1000
+        packed, _ = pack_requests(reqs, now)
+        stc, outc = decide_batch(stc, packed, now)
+        std, outd = decide_batch_donated(std, packed, now)
+        for f in ("status", "remaining", "reset_time", "limit", "err"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(outc, f)), np.asarray(getattr(outd, f)),
+                err_msg=f"step {step_i}: {f} diverged")
+    for i, (c, d) in enumerate(zip(stc, std)):
+        np.testing.assert_array_equal(
+            np.asarray(c), np.asarray(d),
+            err_msg=f"final state col {i} diverged")

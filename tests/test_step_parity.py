@@ -237,7 +237,6 @@ def test_donated_step_matches_copy_step():
     aliasing misuse at the call boundary (a donated input is dead after
     the call; nothing may re-read it)."""
     from gubernator_tpu.core.step import decide_batch_donated
-    from gubernator_tpu.core.table import TableState
 
     rng = np.random.default_rng(3)
     stc = init_table(1 << 12)

@@ -91,6 +91,8 @@ def test_leaky_promotes_and_demotes_preserving_consumption():
         inst.set_peers([PeerInfo(grpc_address="127.0.0.1:1"),
                         PeerInfo(grpc_address="127.0.0.1:2")])
         assert not inst._hotset.is_pinned(kh)
+        assert inst.metrics.hot_demotion_counter.labels(
+            reason="membership_change")._value.get() >= 1
         import numpy as np
 
         found, cols = inst.engine.gather_rows(np.array([kh], np.uint64))
@@ -115,8 +117,14 @@ def test_config_change_demotes_preserving_consumption():
         inst.get_rate_limits([req(key="cfg", limit=100) for _ in range(10)],
                              now_ms=NOW + 1)
         # limit change → demotion: state migrates back, new limit applies
+        before = inst.metrics.hot_demotion_counter.labels(
+            reason="config_change")._value.get()
         r = inst.get_rate_limits([req(key="cfg", limit=50)], now_ms=NOW + 2)[0]
         assert not inst._hotset.is_pinned(kh)
+        # the perf-cliff is observable: demotion shows up at /metrics
+        after = inst.metrics.hot_demotion_counter.labels(
+            reason="config_change")._value.get()
+        assert after == before + 1, (before, after)
         assert r.limit == 50
         # 11 consumed at limit 100 → remaining 89; limit 100→50 adjust:
         # clamp(89 + (50-100), 0, 50) = 39; this request takes 1 → 38
@@ -139,6 +147,8 @@ def test_peers_joining_demotes_hot_keys():
         inst.set_peers([PeerInfo(grpc_address="127.0.0.1:1"),
                         PeerInfo(grpc_address="127.0.0.1:2")])
         assert not inst._hotset.is_pinned(kh)
+        assert inst.metrics.hot_demotion_counter.labels(
+            reason="membership_change")._value.get() >= 1
         # migrated consumption is visible in the sharded table
         import numpy as np
 

@@ -201,17 +201,6 @@ def main():
     }
     _write_partial(result)
 
-    # device-resident superstep (fresh compile — child-isolated on
-    # device backends so a wedged scan compile can't cost the link/
-    # latency rows below; see _sec_scan)
-    scan_rows = _run_section("scan", inline=(backend == "cpu"))
-    dps_scan = float(scan_rows.get("device_scan_decisions_per_s", 0.0))
-    if "error" in scan_rows:
-        log(f"device-scan section: {scan_rows['error']}")
-    else:
-        log(f"device-scan sustained: {dps_scan/1e6:.2f}M/s "
-            f"(R={scan_rows.get('scan_R')})")
-
     # link round-trip floor: a trivial op's dispatch→sync time.  On a
     # direct-attached chip this is ~50 µs; over the axon tunnel it is
     # the WAN round trip (~0.5 ms, with multi-ms jitter tails).  The
@@ -251,6 +240,41 @@ def main():
         log(f"latency: p50={p50:.3f}ms p99={p99:.3f}ms (batch={B})")
     except Exception as e:  # noqa: BLE001
         log(f"latency section failed: {e!r:.200}")
+
+    # The parent's own device work is DONE.  Everything below is a
+    # child-process section (fresh compiles, wedge-isolated).  Release
+    # this process's device client first: if the tunnel is single-
+    # client-exclusive, a held parent client would block every child's
+    # backend init; on multi-client links the release is harmless.
+    # Best-effort — buffers must drop first or the client stays alive.
+    global _EXPECT_BACKEND
+    _EXPECT_BACKEND = backend
+    if backend != "cpu":
+        try:
+            import gc
+
+            # closures (make_batch/populate/measure_mode) pin the
+            # arrays through their cells — drop them all, or the
+            # buffers keep the client alive through clear_backends
+            del state, out, key_batches, const, make_batch, populate
+            del measure_mode, step_best
+            gc.collect()
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+            log("released the parent device client before child sections")
+        except Exception as e:  # noqa: BLE001
+            log(f"device-client release failed (continuing): {e!r:.120}")
+
+    # device-resident superstep (fresh compile — child-isolated on
+    # device backends so a wedged scan compile can't cost later rows)
+    scan_rows = _run_section("scan", inline=(backend == "cpu"))
+    dps_scan = float(scan_rows.get("device_scan_decisions_per_s", 0.0))
+    if "error" in scan_rows:
+        log(f"device-scan section: {scan_rows['error']}")
+    else:
+        log(f"device-scan sustained: {dps_scan/1e6:.2f}M/s "
+            f"(R={scan_rows.get('scan_R')})")
 
     # client-shaped latency: one max-size GetRateLimits batch (1000 reqs
     # in a 1024 bucket) per device call — the p99<2ms target's shape.
@@ -858,6 +882,10 @@ _SECTIONS = {
 _SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot", "cfg5"]
 
 _WEDGED = False  # set when a section timeout + failed device probe
+#: parent's backend, captured BEFORE the device client is released —
+#: _run_section must not call jax.default_backend() itself (that would
+#: re-initialize a client the parent just released)
+_EXPECT_BACKEND = None
 
 
 def _device_probe(timeout=150) -> bool:
@@ -903,12 +931,8 @@ def _run_section(name, inline):
     env = dict(os.environ, GUBER_BENCH_SECTION=name,
                GUBER_BENCH_SECTION_OUT=path)
     env.pop("GUBER_BENCH_INNER", None)
-    try:
-        import jax
-
-        env["GUBER_BENCH_EXPECT_BACKEND"] = jax.default_backend()
-    except Exception:  # noqa: BLE001
-        pass
+    if _EXPECT_BACKEND:
+        env["GUBER_BENCH_EXPECT_BACKEND"] = _EXPECT_BACKEND
     # worst observed tunnel compile is ~305 s; budgets give 3× margin
     # per cold compile a section legitimately needs (svc compiles BOTH
     # wave buckets; cluster/cfg5 one fresh shape each), so one wedged

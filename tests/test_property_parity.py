@@ -3,6 +3,11 @@ the device engine must match the sequential oracle bit-for-bit, and
 oracle invariants must hold."""
 import numpy as np
 from hypothesis import HealthCheck, given, settings
+import os as _os
+
+#: deep-fuzz multiplier: GUBER_FUZZ_X=20 turns the quick CI
+#: budgets into a long adversarial run (same strategies)
+_FX = int(_os.environ.get("GUBER_FUZZ_X", "1"))
 from hypothesis import strategies as st
 
 from gubernator_tpu import Algorithm, Behavior, Oracle, RateLimitRequest
@@ -35,7 +40,7 @@ _stream = st.lists(
     min_size=1, max_size=5)
 
 
-@settings(max_examples=25, deadline=None,
+@settings(max_examples=_FX * 25, deadline=None,
           suppress_health_check=[HealthCheck.too_slow])
 @given(_stream)
 def test_engine_matches_oracle_on_any_stream(stream):
@@ -55,7 +60,7 @@ def test_engine_matches_oracle_on_any_stream(stream):
                 (i, reqs[i])
 
 
-@settings(max_examples=200, deadline=None)
+@settings(max_examples=_FX * 200, deadline=None)
 @given(_request, st.integers(0, 10**6))
 def test_oracle_invariants(req, dt):
     """remaining ∈ [0, max(limit,burst)], reset_time ≥ now, and a
@@ -77,7 +82,7 @@ def test_oracle_invariants(req, dt):
         assert item.remaining >= frozen[k]["remaining"]
 
 
-@settings(max_examples=20, deadline=None,
+@settings(max_examples=_FX * 20, deadline=None,
           suppress_health_check=[HealthCheck.too_slow])
 @given(st.lists(st.tuples(st.lists(_request, min_size=1, max_size=12),
                           st.integers(0, 5_000)),
@@ -142,7 +147,7 @@ _i64_request = st.builds(
 )
 
 
-@settings(max_examples=25, deadline=None,
+@settings(max_examples=_FX * 25, deadline=None,
           suppress_health_check=[HealthCheck.too_slow])
 @given(st.lists(
     st.tuples(st.lists(_i64_request, min_size=1, max_size=24),

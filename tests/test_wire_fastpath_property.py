@@ -4,6 +4,11 @@ pb2 fallback otherwise) must match the sequential oracle bit-for-bit —
 the same referee the object path answers to in test_property_parity."""
 import pytest
 from hypothesis import HealthCheck, given, settings
+import os as _os
+
+#: deep-fuzz multiplier: GUBER_FUZZ_X=20 turns the quick CI
+#: budgets into a long adversarial run (same strategies)
+_FX = int(_os.environ.get("GUBER_FUZZ_X", "1"))
 from hypothesis import strategies as st
 
 from gubernator_tpu import Algorithm, Behavior, Oracle, RateLimitRequest
@@ -50,7 +55,7 @@ def _wire(reqs):
     return m.SerializeToString()
 
 
-@settings(max_examples=20, deadline=None,
+@settings(max_examples=_FX * 20, deadline=None,
           suppress_health_check=[HealthCheck.too_slow])
 @given(_stream)
 def test_wire_lane_matches_oracle_on_any_stream(stream):

@@ -118,26 +118,51 @@ def add_health_servicer(server: grpc.Server, instance) -> None:
         return bytes([0x08, 0x01 if ok else 0x02])
 
     def check(request: bytes, context):
-        return _status()
+        return _safe_status()
 
     cond = threading.Condition()
-    state = {"cur": None, "watchers": 0, "poller": False}
+    #: "poller" holds the Thread object of the ONE live poller (or
+    #: None): ownership is identity-checked under the lock, so a
+    #: dying poller can never clear a replacement's claim
+    state = {"cur": None, "watchers": 0, "poller": None}
     MAX_WATCHERS = 4
+    NOT_SERVING = bytes([0x08, 0x02])
+
+    def _safe_status() -> bytes:
+        try:
+            return _status()
+        except Exception:  # noqa: BLE001 - a failing status source IS
+            # the unhealthy signal; both the poller and watch() must
+            # outlive it or watchers go deaf / leak their slot
+            return NOT_SERVING
 
     def _poller():
         import time as _time
 
-        while True:
+        me = threading.current_thread()
+        try:
+            while True:
+                with cond:
+                    if state["watchers"] == 0 or state["poller"] is not me:
+                        # release the claim HERE, atomically with the
+                        # exit decision: a watcher arriving after this
+                        # lock drops must see no live claim and start a
+                        # replacement (the finally alone would race it)
+                        if state["poller"] is me:
+                            state["poller"] = None
+                        return  # last watcher left (or we were replaced)
+                cur = _safe_status()
+                with cond:
+                    if cur != state["cur"]:
+                        state["cur"] = cur
+                        cond.notify_all()
+                _time.sleep(1.0)
+        finally:
+            # clear ONLY our own claim, atomically — a later watcher
+            # can then start a replacement; never stomp a successor's
             with cond:
-                if state["watchers"] == 0:
-                    state["poller"] = False
-                    return  # last watcher left; the next one restarts us
-            cur = _status()
-            with cond:
-                if cur != state["cur"]:
-                    state["cur"] = cur
-                    cond.notify_all()
-            _time.sleep(1.0)
+                if state["poller"] is me:
+                    state["poller"] = None
 
     def watch(request: bytes, context):
         with cond:
@@ -145,12 +170,22 @@ def add_health_servicer(server: grpc.Server, instance) -> None:
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                               "too many health watchers; poll Check")
             state["watchers"] += 1
-            if state["cur"] is None:
-                state["cur"] = _status()
-            if not state["poller"]:
-                state["poller"] = True
-                threading.Thread(target=_poller, daemon=True,
-                                 name="health-watch-poller").start()
+            try:
+                if state["cur"] is None:
+                    state["cur"] = _safe_status()
+                alive = state["poller"]
+                if alive is None or not alive.is_alive():
+                    t = threading.Thread(target=_poller, daemon=True,
+                                         name="health-watch-poller")
+                    state["poller"] = t
+                    t.start()  # on failure: claim stays on a never-
+                    # started thread; is_alive() is False so the next
+                    # watcher restarts it
+            except BaseException:
+                # the decrementing finally below doesn't exist yet —
+                # give the slot back or it leaks toward the cap
+                state["watchers"] -= 1
+                raise
         last = None
         try:
             while context.is_active():

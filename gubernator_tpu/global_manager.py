@@ -37,6 +37,14 @@ class GlobalManager:
         self._hits: Dict[str, Tuple[RateLimitRequest, int]] = {}
         #: key → request prototype for changed GLOBAL keys — owner side.
         self._updates: Dict[str, RateLimitRequest] = {}
+        #: key-hash → (request TLV bytes, accumulated hits) — the wire
+        #: lane's non-owner side.  The columnar request path queues the
+        #: raw `requests` TLV slice instead of building per-request
+        #: objects; entries materialize into prototypes at flush
+        #: cadence (_req_from_tlv) and merge into _hits.
+        self._hits_raw: Dict[int, Tuple[bytes, int]] = {}
+        #: key-hash → request TLV bytes — the wire lane's owner side.
+        self._updates_raw: Dict[int, bytes] = {}
         self._err_mu = threading.Lock()
         self._last_error = ""
         self._last_error_at = 0.0
@@ -69,6 +77,58 @@ class GlobalManager:
         if n >= self.behaviors.global_batch_limit:
             self._bcast_loop.poke()
 
+    # ---- wire-lane producers (columnar request path) -------------------
+    #
+    # The clustered wire fast lane has no per-request Python objects —
+    # only parsed columns and the raw `requests` TLV slices.  These
+    # producers keep it that way: the request path hands over (key-hash,
+    # TLV bytes, aggregated hits) per UNIQUE key; prototypes are built
+    # lazily at flush cadence, off the request path, then flow through
+    # the same flush/broadcast machinery as the object-path queues (so
+    # a key served through both lanes merges correctly).
+
+    def queue_hits_raw(self, khash: int, tlv: bytes, hits: int) -> None:
+        """Wire-lane twin of ``queue_hits``: accumulate ``hits`` for the
+        key identified by ``khash``, with ``tlv`` (the verbatim
+        GetRateLimitsReq.requests TLV slice) as the deferred prototype."""
+        if hits <= 0:
+            return
+        with self._mu:
+            _, acc = self._hits_raw.get(khash, (tlv, 0))
+            # keep the LATEST tlv as the prototype, exactly as
+            # queue_hits keeps the latest req: a mid-window config
+            # change must reconcile under the new limit/duration
+            self._hits_raw[khash] = (tlv, acc + hits)
+            n = len(self._hits_raw) + len(self._hits)
+        self.metrics.queue_length.set(n)
+        if n >= self.behaviors.global_batch_limit:
+            self._hits_loop.poke()
+
+    def queue_update_raw(self, khash: int, tlv: bytes) -> None:
+        """Wire-lane twin of ``queue_update`` (owner side)."""
+        with self._mu:
+            self._updates_raw[khash] = tlv
+            n = len(self._updates_raw) + len(self._updates)
+        if n >= self.behaviors.global_batch_limit:
+            self._bcast_loop.poke()
+
+    @staticmethod
+    def _req_from_tlv(tlv: bytes) -> RateLimitRequest:
+        """Deferred prototype: TLV slice (tag byte + varint length +
+        RateLimitReq payload) → request object.  Flush-cadence only."""
+        from .proto import gubernator_pb2 as pb
+        from .wire import req_from_pb
+
+        i, shift, ln = 1, 0, 0
+        while True:
+            b = tlv[i]
+            ln |= (b & 0x7F) << shift
+            i += 1
+            if not b & 0x80:
+                break
+            shift += 7
+        return req_from_pb(pb.RateLimitReq.FromString(tlv[i:i + ln]))
+
     # ---- async loops ---------------------------------------------------
 
     def _run_async_hits(self) -> None:
@@ -76,7 +136,19 @@ class GlobalManager:
         reference: global.go › runAsyncHits."""
         with self._mu:
             hits, self._hits = self._hits, {}
+            hits_raw, self._hits_raw = self._hits_raw, {}
         self.metrics.queue_length.set(0)
+        for khash, (tlv, acc) in hits_raw.items():
+            try:
+                req = self._req_from_tlv(tlv)
+            except Exception:  # noqa: BLE001 - a corrupt queued TLV
+                # can only come from a parser bug; drop it rather than
+                # poison the whole flush
+                log.warning("dropping unparseable queued TLV for key "
+                            "hash %d", khash)
+                continue
+            proto, a0 = hits.get(req.key, (req, 0))
+            hits[req.key] = (proto, a0 + acc)
         if not hits:
             return
         # group by owner peer
@@ -114,6 +186,15 @@ class GlobalManager:
         reference: global.go › runBroadcasts → UpdatePeerGlobals."""
         with self._mu:
             updates, self._updates = self._updates, {}
+            updates_raw, self._updates_raw = self._updates_raw, {}
+        for khash, tlv in updates_raw.items():
+            try:
+                req = self._req_from_tlv(tlv)
+            except Exception:  # noqa: BLE001
+                log.warning("dropping unparseable queued TLV for key "
+                            "hash %d", khash)
+                continue
+            updates.setdefault(req.key, req)
         if not updates:
             return
         t0 = time.perf_counter()

@@ -363,10 +363,12 @@ class V1Instance:
         Store hooks, no MULTI_REGION behaviors, no metadata, non-empty
         names/keys.  Solo (no peers beyond self): GLOBAL batches ride a
         columnar hot-set flow (pinned keys → replica step, the rest →
-        sharded step + vectorized promotion counting).  Clustered:
-        non-GLOBAL batches ride the clustered columnar lane — ring-split
-        by owner, owned keys stepped locally, the rest forwarded as raw
-        TLV slices over the peer wire and spliced back in order
+        sharded step + vectorized promotion counting).  Clustered: ALL
+        batches ride the clustered columnar lane — non-GLOBAL rows are
+        ring-split by owner (owned keys stepped locally, the rest
+        forwarded as raw TLV slices over the peer wire and spliced back
+        in order); GLOBAL rows are answered from the local replica with
+        async reconcile queued as raw TLV prototypes
         (_wire_check_clustered).  Anything the lanes can't model falls
         back to the pb2 object path with identical semantics.  Raises
         ValueError on oversize batches (mirroring ``get_rate_limits``).
@@ -386,12 +388,12 @@ class V1Instance:
                     solo = not peer_list or all(
                         self.is_self(p) for p in peer_list)
                     if not solo:
-                        if is_global:
-                            # clustered GLOBAL queues per-request async
-                            # reconciliation — object path
-                            parsed = None
-                        else:
-                            clustered = True
+                        # clustered GLOBAL rides the same columnar lane:
+                        # GLOBAL rows are answered from the local
+                        # replica and their reconcile queues take raw
+                        # TLV slices (global_manager.queue_*_raw), so
+                        # no per-request objects are needed
+                        clustered = True
                     # solo GLOBAL rides the columnar hot-set flow; the
                     # object path's queue_update is a no-op with no
                     # peers (nothing to broadcast to)
@@ -662,7 +664,12 @@ class V1Instance:
         Zero per-request Python objects end to end; the owner side rides
         get_peer_rate_limits_wire's columnar lane.  A failed forward
         degrades to per-request error responses for that sub-batch only,
-        mirroring the object path's per-request forward errors."""
+        mirroring the object path's per-request forward errors.
+
+        GLOBAL rows (global.go semantics, SURVEY §3.3): answered from
+        the LOCAL replica — never forwarded — with hits queued for async
+        reconcile to the owner (raw TLV prototypes, aggregated per
+        unique key; global_manager.queue_hits_raw/queue_update_raw)."""
         from .hashing import mix64_np
 
         n = parsed["n"]
@@ -677,6 +684,28 @@ class V1Instance:
 
         self_pi = [pi for pi, p in enumerate(peer_list) if self.is_self(p)]
         local_mask = np.isin(owners, self_pi)
+        glob_mask = (parsed["behavior"] & int(Behavior.GLOBAL)) != 0
+        if glob_mask.any():
+            # every GLOBAL row is served locally; queue the reconcile
+            # work per UNIQUE key (hot keys repeat, so this loop is
+            # short even for big batches)
+            gm = self._ensure_global_manager()
+            gidx = np.nonzero(glob_mask)[0]
+            w = np.maximum(parsed["hits"][gidx], 0)
+            uniq, first, inv = np.unique(
+                raw[gidx], return_index=True, return_inverse=True)
+            acc = np.bincount(inv, weights=w).astype(np.int64)
+            self_owned = np.isin(owners[gidx], self_pi)
+            for k, f, a in zip(uniq, first, acc):
+                i = int(gidx[int(f)])
+                tlv = bytes(data[int(toff[i]):int(toff[i] + tlen[i])])
+                if self_owned[int(f)]:
+                    # we own it: the authoritative row changes locally;
+                    # broadcast merged state on the next tick
+                    gm.queue_update_raw(int(k), tlv)
+                else:
+                    gm.queue_hits_raw(int(k), tlv, int(a))
+            local_mask = local_mask | glob_mask
         item_tlvs: List[Optional[bytes]] = [None] * n
 
         # fire remote forwards first so the local device step overlaps.
@@ -684,7 +713,11 @@ class V1Instance:
         # dispatch failures travel in their own slot, never by isinstance
         groups = []
         for pi in np.unique(owners[~local_mask]):
-            idxs = np.nonzero(owners == pi)[0]
+            # ~local_mask also excludes GLOBAL rows that share an owner
+            # with forwarded rows: they were answered locally above and
+            # reconcile asynchronously — forwarding them too would
+            # double-debit the owner
+            idxs = np.nonzero((owners == pi) & ~local_mask)[0]
             sub = b"".join(
                 data[int(toff[i]):int(toff[i] + tlen[i])] for i in idxs)
             fut = send_err = None

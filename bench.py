@@ -131,10 +131,11 @@ def main():
         out.status.block_until_ready()
         return st
 
-    def measure_mode(step_fn, label, sustain_target=15_000_000):
+    def measure_mode(step_fn, label, sustain_target=15_000_000,
+                     init_fn=init_table):
         """Compile, populate the full working set, then time a sustained
         dispatch loop at steady state."""
-        st = init_table(CAP)
+        st = init_fn(CAP)
         t0 = time.perf_counter()
         st, out = step_fn(st, make_batch(key_batches[0]),
                           jnp.asarray(NOW0, i64))
@@ -170,11 +171,53 @@ def main():
     except Exception as e:  # noqa: BLE001
         dps_donate = 0.0
         log(f"donated-step mode failed: {e!r:.200}")
-    step_mode = "donate" if dps_donate > dps_copy else "copy"
-    dps = max(dps_copy, dps_donate)
-    step_best = (decide_batch_donated if step_mode == "donate"
+    # mode 3: hand Pallas kernel (ops/pallas_step.py) — its rate is a
+    # FLOOR independent of XLA's scatter/gather lowering choices (the
+    # 209 ms/step copy-mode episode).  Device backends only: interpret
+    # mode is a python-level emulator, minutes per batch.  Its 8-slot
+    # buckets overflow sooner than the XLA probe window, so the bucket
+    # table gets 2× the capacity (its own layout, its own budget) and
+    # a measured err fraction gates the duel: a rate that isn't
+    # serving the whole working set must not win the headline.
+    dps_pallas, pallas_err_frac = 0.0, None
+    if backend != "cpu" and not os.environ.get("GUBER_BENCH_NO_PALLAS"):
+        try:
+            from gubernator_tpu.ops.pallas_step import (
+                decide_batch_pallas, init_pallas_table)
+
+            dps_pallas, st_p = measure_mode(
+                decide_batch_pallas, "pallas",
+                sustain_target=4_000_000,
+                init_fn=lambda cap: init_pallas_table(
+                    min(cap * 2, 1 << 26)))
+            _, sample = decide_batch_pallas(
+                st_p, make_batch(key_batches[0]),
+                jnp.asarray(NOW0 + 10_000, i64))
+            pallas_err_frac = round(
+                float(np.asarray(sample.err).mean()), 6)
+            log(f"[pallas] err fraction at steady state: "
+                f"{pallas_err_frac}")
+        except Exception as e:  # noqa: BLE001
+            log(f"pallas-step mode failed: {e!r:.300}")
+    rates = {"copy": dps_copy, "donate": dps_donate,
+             "pallas": dps_pallas}
+    eligible = dict(rates)
+    if pallas_err_frac is None or pallas_err_frac > 0.005:
+        # bucket-overflow err rows aren't served decisions: a rate
+        # that drops part of the working set can't win the headline
+        eligible.pop("pallas")
+        if pallas_err_frac:
+            log(f"[pallas] disqualified from winning the duel: "
+                f"err fraction {pallas_err_frac} > 0.005")
+    step_mode = max(eligible, key=eligible.get)
+    dps = eligible[step_mode]
+    # sections serve through the engines, which run the XLA step — keep
+    # their mode the best XLA lowering even if pallas wins the duel
+    xla_mode = "donate" if dps_donate > dps_copy else "copy"
+    step_best = (decide_batch_donated if xla_mode == "donate"
                  else decide_batch)
-    log(f"headline mode: {step_mode} ({dps/1e6:.2f}M/s)")
+    log(f"headline mode: {step_mode} ({dps/1e6:.2f}M/s); "
+        f"xla mode for sections: {xla_mode}")
 
     # Checkpoint the headline IMMEDIATELY: every section below (scan,
     # latency, client-batch) needs its own cold compile and any of them
@@ -191,6 +234,8 @@ def main():
             "step_mode": step_mode,
             "copy_mode_decisions_per_s": round(dps_copy),
             "donate_mode_decisions_per_s": round(dps_donate),
+            "pallas_mode_decisions_per_s": round(dps_pallas),
+            "pallas_err_fraction": pallas_err_frac,
             "device_batch": B,
             "backend": backend,
             "config": f"TOKEN_BUCKET {N_KEYS} keys Zipf({ZIPF_A}) hits=1 CAP={CAP}",
@@ -282,7 +327,7 @@ def main():
     # process so a wedged compile (observed 2026-07-31: this exact
     # shape hung the tunnel's compile server for 40+ min) costs this
     # row, not the rest of the run.
-    os.environ["GUBER_BENCH_STEP_MODE"] = step_mode
+    os.environ["GUBER_BENCH_STEP_MODE"] = xla_mode
     if _WEDGED and backend != "cpu":
         # the scan section timed out AND the follow-up probe failed:
         # don't burn another section timeout + probe on a dead link —
@@ -338,7 +383,20 @@ def main():
         result["extra"]["baseline_configs"] = cfgs
         _write_partial(result)
 
-    configs = run_secondary_configs(step_mode, backend, checkpoint=ck)
+    configs = run_secondary_configs(xla_mode, backend, checkpoint=ck)
+    # north-star p99 decomposition (VERDICT r2 item 2): on a tunneled
+    # device the svc percentiles include the WAN round trip; subtract
+    # the measured trivial-op link floor to estimate what a
+    # direct-attached chip would serve (recorded, never substituted
+    # for the measured value)
+    svc = configs.get("6_service_path", {})
+    if (backend != "cpu" and link_p50 > 0
+            and isinstance(svc, dict) and svc.get("svc_p99_ms")):
+        svc["svc_p99_direct_attach_est_ms"] = round(
+            max(float(svc["svc_p99_ms"]) - link_p50, 0.0), 3)
+        svc["svc_p99_est_context"] = (
+            "svc_p99_ms minus link_roundtrip_p50_ms (each synced call "
+            "pays one link round trip); direct-attach estimate only")
     result["extra"]["baseline_configs"] = configs
     _write_partial(result)
     print(json.dumps(result))
@@ -581,8 +639,13 @@ def _sec_cfg4():
         stg, o, _ = step(stg, bg, jnp.asarray(NOW0 + 1 + r, i64))
     o[0].block_until_ready()
     dps4 = reps * Bg / (time.perf_counter() - t0)
-    return {"4_global_sharded": {"decisions_per_s": round(dps4),
-                                 "n_shards": int(n)}}
+    row = {"decisions_per_s": round(dps4), "n_shards": int(n)}
+    if n == 1:
+        row["context"] = ("single device: pays shard_map overhead with "
+                          "no scaling; per-shard cost is flat 1→8 on "
+                          "the virtual mesh (BASELINE.md weak-scaling "
+                          "table)")
+    return {"4_global_sharded": row}
 
 
 def _sec_svc():
@@ -714,11 +777,128 @@ def _sec_cluster():
         dps_c3 = reps * 1000 / (time.perf_counter() - t0)
         lane = inst0.metrics.wire_lane_counter.labels(
             lane="wire_clustered")._value.get()
-        return {"9_clustered_service": {
-            "decisions_per_s": round(dps_c3), "daemons": 3,
-            "wire_clustered_requests": int(lane)}}
+        row = {"decisions_per_s": round(dps_c3), "daemons": 3,
+               "wire_clustered_requests": int(lane)}
+        cores = len(os.sched_getaffinity(0)) if hasattr(
+            os, "sched_getaffinity") else (os.cpu_count() or 1)
+        if cores < 3:
+            # VERDICT r2 weak #3: without this, the row reads as a
+            # regression vs the single-daemon row
+            row["context"] = (
+                f"{cores}-core host serializes all 3 daemons; below "
+                "the single-daemon row by construction, not a "
+                "clustering regression (PERF.md §4.1)")
+        return {"9_clustered_service": row}
     finally:
         c3.stop()
+
+
+def _group_contention_probe(n_procs: int, reps_g: int) -> dict:
+    """Small SO_REUSEPORT group on a starved host: verifies the group
+    SURVIVES contention (no failed calls; a shared key drains exactly
+    once per hit across connections/processes) and that the kernel
+    actually spreads connections — the measurable ingredients of the
+    ≥4-core scaling claim.  The rate is labeled as contention, never
+    as scaling."""
+    import threading as _th
+    import urllib.request
+
+    import grpc as _grpc
+
+    from gubernator_tpu.cluster import start_subprocess_group
+
+    gdatas = _serialize_reqs(_make_reqs(np.random.default_rng(7),
+                                        name="grp"))
+    grp = start_subprocess_group(n_procs, cache_size=1 << 14,
+                                 batch_rows=1024)
+    chans = []
+    try:
+        n_chan = 2 * n_procs
+        chans = [_grpc.insecure_channel(
+            grp.client_address,
+            options=[("grpc.use_local_subchannel_pool", 1)])
+            for _ in range(n_chan)]
+        calls = [c.unary_unary("/pb.gubernator.V1/GetRateLimits")
+                 for c in chans]
+        for call in calls:
+            call(gdatas[0], timeout=120)
+        lat, errors = [[] for _ in range(n_chan)], []
+
+        def _w(t):
+            try:
+                for r in range(reps_g):
+                    t1 = time.perf_counter()
+                    calls[t](gdatas[(t + r) % 4], timeout=120)
+                    lat[t].append((time.perf_counter() - t1) * 1e3)
+            except Exception as e:  # noqa: BLE001
+                errors.append(str(e)[:120])
+
+        ths = [_th.Thread(target=_w, args=(t,)) for t in range(n_chan)]
+        t0 = time.perf_counter()
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        wall = time.perf_counter() - t0
+        flat = [x for ls in lat for x in ls]
+        spread = 0
+        for addr in grp.http_addresses:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{addr}/metrics", timeout=10) as f:
+                    text = f.read().decode()
+                got = any(
+                    line.split()[-1] not in ("0", "0.0")
+                    for line in text.splitlines()
+                    if line.startswith(
+                        "gubernator_wire_lane_requests_total")
+                    and ('lane="wire_local"' in line
+                         or 'lane="wire_clustered"' in line))
+                spread += bool(got)
+            except Exception:  # noqa: BLE001
+                pass
+        # conservation: one key drained through every connection (the
+        # kernel spreads them over processes) must debit exactly once
+        # per hit — ring ownership, not per-process buckets
+        conserved = None
+        try:
+            from gubernator_tpu.proto import gubernator_pb2 as _pb
+
+            def _one(hits):
+                m = _pb.GetRateLimitsReq()
+                r = m.requests.add()
+                r.name, r.unique_key = "grpcons", "shared"
+                r.hits, r.limit, r.duration = hits, 10**6, 600_000
+                return m.SerializeToString()
+
+            for t in range(n_chan):
+                calls[t](_one(3), timeout=120)
+            q = _pb.GetRateLimitsResp.FromString(
+                calls[0](_one(0), timeout=120))
+            conserved = (int(q.responses[0].remaining)
+                         == 10**6 - 3 * n_chan)
+        except Exception as e:  # noqa: BLE001
+            conserved = f"check failed: {str(e)[:120]}"
+        row = {f"contention_{n_procs}proc_decisions_per_s": round(
+            len(flat) * 1000 / wall),
+            "contention_completed_calls": len(flat),
+            "contention_expected_calls": n_chan * reps_g,
+            "conservation_exact": conserved,
+            "processes_seeing_traffic": spread,
+            "processes": n_procs}
+        if flat:
+            row["contention_p99_ms"] = round(
+                float(np.percentile(flat, 99)), 3)
+        if errors:
+            row["contention_worker_errors"] = errors[:3]
+        return row
+    finally:
+        for c in chans:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        grp.stop()
 
 
 def _sec_group():
@@ -734,10 +914,26 @@ def _sec_group():
     if os.environ.get("GUBER_BENCH_SKIP_GROUP"):
         return {}
     if host_cores < 4:
-        return {"10_reuseport_group": {
-            "skipped": f"host has {host_cores} core(s); the SO_REUSEPORT "
-                       "group measures process-level front-door scaling "
-                       "and needs >=4"}}
+        # Scaling is unmeasurable here, but the INGREDIENTS aren't:
+        # run a small 2-process group anyway to verify correctness
+        # under contention + kernel connection spreading, and record
+        # the falsifiable aggregation model (BASELINE.md "Front-door
+        # scaling model") its ≥4-core projection comes from.
+        row = {
+            "skipped_scaling": (
+                f"host has {host_cores} core(s); the process-scaling "
+                "number needs >=4 — rate below measures contention "
+                "survival, not scaling"),
+            "model": ("aggregate ~= N_procs * per_process_rate * "
+                      "eff(0.5-0.7); per_process_rate = "
+                      "6_service_path.concurrent16_decisions_per_s; "
+                      "the (N-1)/N forward hop is inside eff"),
+        }
+        try:
+            row.update(_group_contention_probe(n_procs=2, reps_g=8))
+        except Exception as e:  # noqa: BLE001
+            row["contention_error"] = str(e)[:200]
+        return {"10_reuseport_group": row}
     import threading as _th
 
     import grpc as _grpc
@@ -1025,9 +1221,10 @@ def run_secondary_configs(step_mode, backend, checkpoint=None):
     runs after each section so rows measured before a late-stage
     device failure survive (see _write_partial)."""
     # serving engines in the sections read this at construction: they
-    # must run the mode that won — set it explicitly BOTH ways so a
-    # pre-existing operator export can't make the rows measure a
-    # different mode than reported (children inherit it)
+    # must run the best XLA mode (the engines don't serve the pallas
+    # kernel) — set it explicitly BOTH ways so a pre-existing operator
+    # export can't make the rows measure a different mode than
+    # reported (children inherit it)
     os.environ["GUBER_STEP_DONATE"] = ("1" if step_mode == "donate"
                                       else "0")
     os.environ["GUBER_BENCH_STEP_MODE"] = step_mode

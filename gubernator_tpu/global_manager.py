@@ -33,18 +33,25 @@ class GlobalManager:
         self.behaviors = behaviors
         self.metrics = metrics
         self._mu = threading.Lock()
-        #: key → (request prototype, accumulated hits) — non-owner side.
-        self._hits: Dict[str, Tuple[RateLimitRequest, int]] = {}
-        #: key → request prototype for changed GLOBAL keys — owner side.
-        self._updates: Dict[str, RateLimitRequest] = {}
-        #: key-hash → (request TLV bytes, accumulated hits) — the wire
-        #: lane's non-owner side.  The columnar request path queues the
-        #: raw `requests` TLV slice instead of building per-request
+        #: cross-lane arrival order (under _mu): when the SAME key is
+        #: queued through both the object and wire lanes in one window,
+        #: the prototype with the highest seq wins the flush-time merge
+        #: — "latest config wins" must hold across lanes, not just
+        #: within one
+        self._seq = 0
+        #: key → (request prototype, accumulated hits, seq) — non-owner.
+        self._hits: Dict[str, Tuple[RateLimitRequest, int, int]] = {}
+        #: key → (seq, request prototype) for changed GLOBAL keys —
+        #: owner side.
+        self._updates: Dict[str, Tuple[int, RateLimitRequest]] = {}
+        #: key-hash → (request TLV bytes, accumulated hits, seq) — the
+        #: wire lane's non-owner side.  The columnar request path queues
+        #: the raw `requests` TLV slice instead of building per-request
         #: objects; entries materialize into prototypes at flush
         #: cadence (_req_from_tlv) and merge into _hits.
-        self._hits_raw: Dict[int, Tuple[bytes, int]] = {}
-        #: key-hash → request TLV bytes — the wire lane's owner side.
-        self._updates_raw: Dict[int, bytes] = {}
+        self._hits_raw: Dict[int, Tuple[bytes, int, int]] = {}
+        #: key-hash → (seq, request TLV bytes) — wire lane, owner side.
+        self._updates_raw: Dict[int, Tuple[int, bytes]] = {}
         self._err_mu = threading.Lock()
         self._last_error = ""
         self._last_error_at = 0.0
@@ -61,9 +68,13 @@ class GlobalManager:
         """Accumulate hits for async reconcile to the owner.
         reference: global.go › QueueHits."""
         with self._mu:
-            proto, acc = self._hits.get(req.key, (req, 0))
-            self._hits[req.key] = (req, acc + max(int(req.hits), 0))
-            n = len(self._hits)
+            self._seq += 1
+            _, acc, _ = self._hits.get(req.key, (req, 0, 0))
+            self._hits[req.key] = (req, acc + max(int(req.hits), 0),
+                                   self._seq)
+            # both lanes share the flush: threshold and gauge must see
+            # the raw queue too or mixed-lane traffic undercounts
+            n = len(self._hits) + len(self._hits_raw)
         self.metrics.queue_length.set(n)
         if n >= self.behaviors.global_batch_limit:
             self._hits_loop.poke()
@@ -72,8 +83,9 @@ class GlobalManager:
         """Mark a GLOBAL key changed on the owner; broadcast on next tick.
         reference: global.go › QueueUpdate."""
         with self._mu:
-            self._updates[req.key] = req
-            n = len(self._updates)
+            self._seq += 1
+            self._updates[req.key] = (self._seq, req)
+            n = len(self._updates) + len(self._updates_raw)
         if n >= self.behaviors.global_batch_limit:
             self._bcast_loop.poke()
 
@@ -94,11 +106,12 @@ class GlobalManager:
         if hits <= 0:
             return
         with self._mu:
-            _, acc = self._hits_raw.get(khash, (tlv, 0))
+            self._seq += 1
+            _, acc, _ = self._hits_raw.get(khash, (tlv, 0, 0))
             # keep the LATEST tlv as the prototype, exactly as
             # queue_hits keeps the latest req: a mid-window config
             # change must reconcile under the new limit/duration
-            self._hits_raw[khash] = (tlv, acc + hits)
+            self._hits_raw[khash] = (tlv, acc + hits, self._seq)
             n = len(self._hits_raw) + len(self._hits)
         self.metrics.queue_length.set(n)
         if n >= self.behaviors.global_batch_limit:
@@ -107,7 +120,8 @@ class GlobalManager:
     def queue_update_raw(self, khash: int, tlv: bytes) -> None:
         """Wire-lane twin of ``queue_update`` (owner side)."""
         with self._mu:
-            self._updates_raw[khash] = tlv
+            self._seq += 1
+            self._updates_raw[khash] = (self._seq, tlv)
             n = len(self._updates_raw) + len(self._updates)
         if n >= self.behaviors.global_batch_limit:
             self._bcast_loop.poke()
@@ -138,7 +152,7 @@ class GlobalManager:
             hits, self._hits = self._hits, {}
             hits_raw, self._hits_raw = self._hits_raw, {}
         self.metrics.queue_length.set(0)
-        for khash, (tlv, acc) in hits_raw.items():
+        for khash, (tlv, acc, seq) in hits_raw.items():
             try:
                 req = self._req_from_tlv(tlv)
             except Exception:  # noqa: BLE001 - a corrupt queued TLV
@@ -147,13 +161,14 @@ class GlobalManager:
                 log.warning("dropping unparseable queued TLV for key "
                             "hash %d", khash)
                 continue
-            proto, a0 = hits.get(req.key, (req, 0))
-            hits[req.key] = (proto, a0 + acc)
+            proto, a0, s0 = hits.get(req.key, (req, 0, seq))
+            hits[req.key] = (req if seq >= s0 else proto, a0 + acc,
+                             max(s0, seq))
         if not hits:
             return
         # group by owner peer
         by_owner: Dict[str, Tuple[object, List[RateLimitRequest]]] = {}
-        for key, (req, acc) in hits.items():
+        for key, (req, acc, _seq) in hits.items():
             if acc <= 0:
                 continue
             peer = self.instance.owner_of(key)
@@ -187,18 +202,21 @@ class GlobalManager:
         with self._mu:
             updates, self._updates = self._updates, {}
             updates_raw, self._updates_raw = self._updates_raw, {}
-        for khash, tlv in updates_raw.items():
+        for khash, (seq, tlv) in updates_raw.items():
             try:
                 req = self._req_from_tlv(tlv)
             except Exception:  # noqa: BLE001
                 log.warning("dropping unparseable queued TLV for key "
                             "hash %d", khash)
                 continue
-            updates.setdefault(req.key, req)
+            cur = updates.get(req.key)
+            if cur is None or seq > cur[0]:
+                updates[req.key] = (seq, req)
         if not updates:
             return
         t0 = time.perf_counter()
-        msgs = self.instance.build_global_updates(list(updates.values()))
+        msgs = self.instance.build_global_updates(
+            [r for _, r in updates.values()])
         if not msgs:
             return
         peers = [p for p in self.instance.peers() if not self.instance.is_self(p)]

@@ -685,26 +685,30 @@ class V1Instance:
         self_pi = [pi for pi, p in enumerate(peer_list) if self.is_self(p)]
         local_mask = np.isin(owners, self_pi)
         glob_mask = (parsed["behavior"] & int(Behavior.GLOBAL)) != 0
+        glob_queue: List[tuple] = []
         if glob_mask.any():
-            # every GLOBAL row is served locally; queue the reconcile
+            # every GLOBAL row is served locally; collect the reconcile
             # work per UNIQUE key (hot keys repeat, so this loop is
-            # short even for big batches)
-            gm = self._ensure_global_manager()
+            # short even for big batches).  The owner-side queue_update
+            # entries are ENQUEUED ONLY AFTER the local step below: a
+            # broadcast tick firing in between would gather a row that
+            # doesn't exist yet and silently drop the update (observed
+            # as a cold-compile-window flake).
             gidx = np.nonzero(glob_mask)[0]
             w = np.maximum(parsed["hits"][gidx], 0)
-            uniq, first, inv = np.unique(
-                raw[gidx], return_index=True, return_inverse=True)
+            uniq, inv = np.unique(raw[gidx], return_inverse=True)
             acc = np.bincount(inv, weights=w).astype(np.int64)
             self_owned = np.isin(owners[gidx], self_pi)
-            for k, f, a in zip(uniq, first, acc):
+            # LAST occurrence per unique key is the prototype — a
+            # mid-batch config change must reconcile under the new
+            # limit/duration, matching queue_hits (latest req wins)
+            last = np.zeros(uniq.size, np.int64)
+            last[inv] = np.arange(inv.size)
+            for k, f, a in zip(uniq, last, acc):
                 i = int(gidx[int(f)])
                 tlv = bytes(data[int(toff[i]):int(toff[i] + tlen[i])])
-                if self_owned[int(f)]:
-                    # we own it: the authoritative row changes locally;
-                    # broadcast merged state on the next tick
-                    gm.queue_update_raw(int(k), tlv)
-                else:
-                    gm.queue_hits_raw(int(k), tlv, int(a))
+                glob_queue.append(
+                    (int(k), tlv, int(a), bool(self_owned[int(f)])))
             local_mask = local_mask | glob_mask
         item_tlvs: List[Optional[bytes]] = [None] * n
 
@@ -739,6 +743,15 @@ class V1Instance:
             lo, ll, _ = _wire_native.split_resp_items(lbytes)
             for j, i in enumerate(local_idx):
                 item_tlvs[int(i)] = lbytes[int(lo[j]):int(lo[j] + ll[j])]
+        if glob_queue:
+            # rows exist now (the step above wrote them): safe to queue
+            # owner-side updates for the next broadcast tick
+            gm = self._ensure_global_manager()
+            for k, tlv, a, own in glob_queue:
+                if own:
+                    gm.queue_update_raw(k, tlv)
+                else:
+                    gm.queue_hits_raw(k, tlv, a)
 
         for idxs, fut, send_err in groups:
             rbytes, err, sp = None, send_err, None
@@ -783,6 +796,7 @@ class V1Instance:
         fwd: List[tuple[int, PeerClient, RateLimitRequest]] = []
 
         have_peers = bool(self.peers())
+        glob_q: List[tuple] = []  # (req, we_are_owner), queued post-step
         # hot loop: plain-int flag tests (IntFlag.__and__ costs ~µs each
         # and this loop runs per request)
         GLOBAL = int(Behavior.GLOBAL)
@@ -810,13 +824,13 @@ class V1Instance:
                     continue
                 # Otherwise: answer from the local replica now, reconcile
                 # hits to the owner asynchronously (global.go semantics).
+                # Owner-side queue_update is deferred until AFTER the
+                # local step below — a broadcast tick firing first would
+                # gather a not-yet-written row and drop the update.
                 local_idx.append(i)
-                gm = self._ensure_global_manager()
                 owner = self.owner_of(req.key) if have_peers else None
-                if owner is not None and not self.is_self(owner):
-                    gm.queue_hits(req)
-                else:
-                    gm.queue_update(req)
+                glob_q.append(
+                    (req, owner is None or self.is_self(owner)))
                 continue
             if not have_peers:
                 local_idx.append(i)
@@ -876,6 +890,13 @@ class V1Instance:
             self._after_local(
                 [reqs[i] for i in local_idx],
                 [responses[i] for i in local_idx])
+        if glob_q:
+            gm = self._ensure_global_manager()
+            for req, own in glob_q:
+                if own:
+                    gm.queue_update(req)  # row written by the step above
+                else:
+                    gm.queue_hits(req)
         if self._promote_pending:
             self._drain_promotions(now)
 

@@ -1,0 +1,498 @@
+"""Pallas TPU kernel: the TOKEN_BUCKET decision step (probe → gather →
+update → scatter) as ONE hand-scheduled Mosaic program.
+
+Why this exists (VERDICT r2 item 4, SURVEY §2.2 north star): the XLA
+decision step's throughput is lowering-sensitive — the same program has
+measured 500 M dec/s (donated) and 209 ms/step (copy-mode scatters
+serialized) on the same chip on the same day.  This kernel owns its
+memory traffic explicitly, so its rate is a measured FLOOR independent
+of XLA's scatter/gather lowering choices.  bench.py enters it in the
+per-run mode duel alongside copy/donate (`extra.step_mode` can report
+"pallas").
+
+Design (TPU-first, not a translation):
+
+- **Bucketized AoS table.**  Instead of the XLA path's SoA columns +
+  double-hash probing (9 scattered per-row touches), the Pallas table
+  is `[CAP, 32] int32`: 8-slot buckets of 128-byte rows, so ONE 1 KiB
+  DMA moves a key's entire probe window *with* its data.  Layout is a
+  mode-level choice — decisions are layout-independent, and the parity
+  tests assert exactly that.
+- **Sequential grid + in-tile serial loop.**  TPU Pallas grids run
+  sequentially, which gives cross-tile duplicate ordering for free;
+  within a tile a `fori_loop` applies requests strictly in order
+  against the live VMEM bucket copies (deduplicated via a host-computed
+  first-occurrence map), reproducing the reference's sequential
+  per-request semantics by construction — duplicates, config changes,
+  RESET/DRAIN flags and all.
+- **int64 as 2×i32 lanes** (as ops/pallas_sweep.py already does):
+  Mosaic has no 64-bit vector lanes.  Times (now/t/expire/duration,
+  ~2^41 ms) use paired-word add/compare; counter values (hits, limit,
+  burst, remaining) are host-qualified to < 2^30 and use plain i32
+  arithmetic.
+
+Domain (host-checked by ``pallas_qualifies``): TOKEN_BUCKET only —
+LEAKY's td fixed point needs 64-bit multiply/divide, which this
+prototype does not implement (the XLA modes serve it).  All TOKEN
+behaviors are supported: RESET_REMAINING, DRAIN_OVER_LIMIT,
+DURATION_IS_GREGORIAN (greg_end is a precomputed column), hits==0
+queries, mixed per-request `now`.
+
+Use ``interpret=True`` (or the CPU backend) for the reference
+interpreter used by the parity tests.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..core.batch import RequestBatch
+from ..core.step import StepOutput
+from ..types import Behavior
+
+SLOTS = 8  # probe window = one bucket
+WORDS = 32  # i32 words per row (128 B — DMA-friendly, room to grow)
+TILE = 128  # requests per grid step
+
+#: value bound for i32 counter arithmetic (limit-change adjustment adds
+#: two limits before clipping, so 2^30 keeps every intermediate in i32)
+VALUE_BOUND = 1 << 30
+
+_RESET = int(Behavior.RESET_REMAINING)
+_DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
+_GREG = int(Behavior.DURATION_IS_GREGORIAN)
+
+# ---- row word layout (i32 words within a 32-word slot) -----------------
+W_KLO, W_KHI = 0, 1
+W_REM, W_STATUS, W_LIMIT = 2, 3, 4
+W_TLO, W_THI = 5, 6
+W_XLO, W_XHI = 7, 8  # expire_at
+W_ELO, W_EHI = 9, 10  # eff_ms
+W_DLO, W_DHI = 11, 12  # duration
+# words 13..31: reserved (leaky td state, burst, alg when the kernel
+# grows past the token domain)
+
+#: python int, not a jnp constant: a module-level traced array would be
+#: captured by the kernel closure, which pallas_call rejects
+_FLIP = -2147483648
+
+
+def _ult(a, b):
+    """unsigned-i32 a < b on reinterpreted int32 words."""
+    return (a ^ _FLIP) < (b ^ _FLIP)
+
+
+def _uge(a, b):
+    return ~_ult(a, b)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = _ult(lo, al).astype(jnp.int32)
+    return ah + bh + carry, lo
+
+
+def _ge64(ah, al, bh, bl):
+    """signed 64-bit (ah:al) >= (bh:bl)."""
+    return (ah > bh) | ((ah == bh) & _uge(al, bl))
+
+
+def _neq64(ah, al, bh, bl):
+    return (ah != bh) | (al != bl)
+
+
+def _sel(c, a, b):
+    return jnp.where(c, a, b)
+
+
+def _sel64(c, ah, al, bh, bl):
+    return jnp.where(c, ah, bh), jnp.where(c, al, bl)
+
+
+def _split64(x):
+    u = x.astype(jnp.uint64)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
+    lo = u.astype(jnp.uint32).astype(jnp.int32)
+    return hi, lo
+
+
+def _join64(hi, lo, dtype):
+    u = (hi.astype(jnp.uint32).astype(jnp.uint64) << jnp.uint64(32)) | \
+        lo.astype(jnp.uint32).astype(jnp.uint64)
+    return u.astype(dtype)
+
+
+class PallasTable(NamedTuple):
+    """Bucketized AoS table: ``rows[CAP, WORDS]`` int32, CAP a power of
+    two ≥ 8; bucket b = rows[8b : 8b+8].  Empty slot: key words 0."""
+
+    rows: jax.Array
+
+
+def init_pallas_table(capacity: int) -> PallasTable:
+    if capacity < SLOTS or capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two >= {SLOTS}")
+    return PallasTable(rows=jnp.zeros((capacity, WORDS), jnp.int32))
+
+
+def pallas_qualifies(batch: RequestBatch) -> bool:
+    """Host-side domain check (np, cheap): every valid row TOKEN_BUCKET
+    with counter values inside the i32-arithmetic bound, and per-key
+    arrival times non-decreasing in batch order (the kernel applies
+    requests strictly in batch order, where the XLA path re-sorts each
+    key's segment by arrival time — a time-inverted duplicate pair
+    would serialize differently)."""
+    import numpy as np
+
+    v = np.asarray(batch.valid)
+    alg = np.asarray(batch.algorithm)
+    if (v & (alg != 0)).any():
+        return False
+    for col in (batch.hits, batch.limit, batch.burst):
+        c = np.asarray(col)
+        if ((v) & ((c < 0) | (c >= VALUE_BOUND))).any():
+            return False
+    if batch.now is not None:
+        now = np.asarray(batch.now)
+        if now.size and not (now == now.flat[0]).all():
+            # stable key sort preserves batch order within a key, so
+            # per-key monotonicity = non-decreasing now on same-key
+            # neighbors in that order
+            order = np.argsort(np.asarray(batch.key), kind="stable")
+            k_s, n_s, v_s = (np.asarray(batch.key)[order], now[order],
+                             v[order])
+            same = (k_s[1:] == k_s[:-1]) & v_s[1:] & v_s[:-1]
+            if (same & (n_s[1:] < n_s[:-1])).any():
+                return False
+    return True
+
+
+def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
+            dlo_ref, dhi_ref, elo_ref, ehi_ref, glo_ref, ghi_ref,
+            beh_ref, nlo_ref, nhi_ref, valid_ref,
+            _table_in, table_ref, st_o, rem_o, rlo_o, rhi_o, lim_o,
+            flg_o, scratch, sem_in, sem_out):
+    """One grid step = one TILE of requests, strictly in order.
+
+    scratch[j*8:(j+1)*8] holds request j's bucket copy iff j is its
+    tile-first occurrence (brep[j] == j); later same-bucket requests
+    read/write the first copy, so in-tile duplicates see each other's
+    updates exactly as a sequential loop would."""
+    i32 = jnp.int32
+
+    def first_live(j):
+        return (brep_ref[0, j] == j) & (valid_ref[0, j] != 0)
+
+    # 1) gather: one DMA per distinct live bucket in the tile
+    def issue_in(j, c):
+        @pl.when(first_live(j))
+        def _():
+            pltpu.make_async_copy(
+                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                scratch.at[pl.ds(j * SLOTS, SLOTS)],
+                sem_in.at[j]).start()
+        return c
+
+    lax.fori_loop(0, TILE, issue_in, 0)
+
+    def wait_in(j, c):
+        @pl.when(first_live(j))
+        def _():
+            pltpu.make_async_copy(
+                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                scratch.at[pl.ds(j * SLOTS, SLOTS)],
+                sem_in.at[j]).wait()
+        return c
+
+    lax.fori_loop(0, TILE, wait_in, 0)
+
+    lane = lax.broadcasted_iota(i32, (SLOTS, WORDS), 1)
+    srow = lax.broadcasted_iota(i32, (SLOTS,), 0)
+
+    # 2) apply requests in order against the live bucket copies
+    def body(j, c):
+        valid = valid_ref[0, j] != 0
+
+        @pl.when(valid)
+        def _process():
+            base = brep_ref[0, j] * SLOTS
+            tile = scratch[pl.ds(base, SLOTS), :]  # [SLOTS, WORDS]
+            klo, khi = klo_ref[0, j], khi_ref[0, j]
+
+            def col(w):
+                return tile[:, w]
+
+            match = (col(W_KLO) == klo) & (col(W_KHI) == khi)
+            found = jnp.any(match)
+            empty = (col(W_KLO) == 0) & (col(W_KHI) == 0)
+            # first empty slot (cumsum trick: stable, deterministic)
+            first_empty = empty & (jnp.cumsum(
+                empty.astype(jnp.float32)) < 1.5) & (jnp.cumsum(
+                    empty.astype(jnp.float32)) > 0.5)
+            has_empty = jnp.any(empty)
+            insert = (~found) & has_empty
+            err = (~found) & (~has_empty)  # bucket full
+            slot1h = jnp.where(found, match, first_empty)  # [SLOTS]
+
+            def pick(w):
+                """matched/claimed slot's word w as a scalar (0 for a
+                fresh insert: empty slots hold zero words)."""
+                return jnp.sum(jnp.where(slot1h, col(w), i32(0)))
+
+            # item state (insert reads the zeroed empty slot → fresh
+            # fires below, matching the XLA path's post-insert read)
+            it_rem, it_status, it_limit = (pick(W_REM), pick(W_STATUS),
+                                           pick(W_LIMIT))
+            it_tlo, it_thi = pick(W_TLO), pick(W_THI)
+            it_xlo, it_xhi = pick(W_XLO), pick(W_XHI)
+            it_elo, it_ehi = pick(W_ELO), pick(W_EHI)
+            it_dlo, it_dhi = pick(W_DLO), pick(W_DHI)
+
+            # request fields
+            r_hits, r_lim = hits_ref[0, j], lim_ref[0, j]
+            r_dlo, r_dhi = dlo_ref[0, j], dhi_ref[0, j]
+            r_elo, r_ehi = elo_ref[0, j], ehi_ref[0, j]
+            r_glo, r_ghi = glo_ref[0, j], ghi_ref[0, j]
+            beh = beh_ref[0, j]
+            is_greg = (beh & _GREG) != 0
+            reset = (beh & _RESET) != 0
+            drain = (beh & _DRAIN) != 0
+
+            # now = max(req.now, item.t)  (per-key monotonic clock)
+            nhi0, nlo0 = nhi_ref[0, j], nlo_ref[0, j]
+            use_req = _ge64(nhi0, nlo0, it_thi, it_tlo)
+            nhi1, nlo1 = _sel64(use_req, nhi0, nlo0, it_thi, it_tlo)
+
+            # fresh: empty/expired (alg change impossible: token-only)
+            fresh = (~found) | _ge64(nhi1, nlo1, it_xhi, it_xlo)
+            # token duration change → recompute expiry from item.t
+            dur_change = (~fresh) & _neq64(r_dhi, r_dlo, it_dhi, it_dlo)
+            ne_hi, ne_lo = _add64(it_thi, it_tlo, r_ehi, r_elo)
+            ne_hi, ne_lo = _sel64(is_greg, r_ghi, r_glo, ne_hi, ne_lo)
+            x1hi, x1lo = _sel64(dur_change, ne_hi, ne_lo, it_xhi, it_xlo)
+            fresh = fresh | (dur_change & ~_ge64(x1hi, x1lo, nhi1, nlo1)
+                             ) | (dur_change & _ge64(nhi1, nlo1, x1hi,
+                                                     x1lo))
+            # (exp1 <= now  ≡  now >= exp1; the first disjunct above is
+            # exp1 < now via !(exp1 >= now) — keep both for exactness
+            # with oracle's `exp1 <= now`)
+
+            # adopt fresh or existing
+            xf_hi, xf_lo = _add64(nhi1, nlo1, r_ehi, r_elo)
+            xf_hi, xf_lo = _sel64(is_greg, r_ghi, r_glo, xf_hi, xf_lo)
+            limit0 = _sel(fresh, r_lim, it_limit)
+            rem0 = _sel(fresh, r_lim, it_rem)
+            t_hi, t_lo = _sel64(fresh, nhi1, nlo1, it_thi, it_tlo)
+            x_hi, x_lo = _sel64(fresh, xf_hi, xf_lo, x1hi, x1lo)
+            status0 = _sel(fresh, i32(0), it_status)
+            e_hi, e_lo = _sel64(fresh | dur_change, r_ehi, r_elo,
+                                it_ehi, it_elo)
+
+            # RESET_REMAINING on existing items
+            reset_live = reset & (~fresh)
+            rem0 = _sel(reset_live, r_lim, rem0)
+            status0 = _sel(reset_live, i32(0), status0)
+            limit_ar = _sel(reset_live, r_lim, limit0)
+
+            # token limit change in place
+            lim_change = r_lim != limit_ar
+            rem_adj = jnp.clip(rem0 + r_lim - limit_ar, i32(0), r_lim)
+            rem0 = _sel(lim_change, rem_adj, rem0)
+
+            # hits
+            is_query = r_hits == i32(0)
+            ok = r_hits <= rem0
+            rem2 = _sel((~is_query) & ok, rem0 - r_hits, rem0)
+            rem2 = _sel((~is_query) & (~ok) & drain, i32(0), rem2)
+            status1 = _sel(is_query, status0,
+                           _sel(ok, i32(0), i32(1)))
+
+            # write the slot back (unless the bucket was full)
+            @pl.when(~err)
+            def _writeback():
+                sel = slot1h[:, None]
+
+                def put(t, w, v):
+                    return jnp.where(sel & (lane == w), v, t)
+
+                nt = tile
+                nt = put(nt, W_KLO, klo)
+                nt = put(nt, W_KHI, khi)
+                nt = put(nt, W_REM, rem2)
+                nt = put(nt, W_STATUS, status1)
+                nt = put(nt, W_LIMIT, r_lim)
+                nt = put(nt, W_TLO, t_lo)
+                nt = put(nt, W_THI, t_hi)
+                nt = put(nt, W_XLO, x_lo)
+                nt = put(nt, W_XHI, x_hi)
+                nt = put(nt, W_ELO, e_lo)
+                nt = put(nt, W_EHI, e_hi)
+                nt = put(nt, W_DLO, r_dlo)
+                nt = put(nt, W_DHI, r_dhi)
+                scratch[pl.ds(base, SLOTS), :] = nt
+
+            # outputs (err rows zeroed, as the XLA step masks them)
+            dead = err
+            st_o[0, j] = _sel(dead, i32(0), status1)
+            rem_o[0, j] = _sel(dead, i32(0), rem2)
+            rlo_o[0, j] = _sel(dead, i32(0), x_lo)
+            rhi_o[0, j] = _sel(dead, i32(0), x_hi)
+            lim_o[0, j] = _sel(dead, i32(0), r_lim)
+            flg_o[0, j] = err.astype(i32) | (
+                (insert & ~err).astype(i32) << 1)
+
+        @pl.when(~valid)
+        def _invalid():
+            st_o[0, j] = i32(0)
+            rem_o[0, j] = i32(0)
+            rlo_o[0, j] = i32(0)
+            rhi_o[0, j] = i32(0)
+            lim_o[0, j] = i32(0)
+            flg_o[0, j] = i32(0)
+
+        return c
+
+    lax.fori_loop(0, TILE, body, 0)
+
+    # 3) scatter: write distinct live buckets back, then fence the tile
+    # (the wait orders these stores before the NEXT tile's gathers)
+    def issue_out(j, c):
+        @pl.when(first_live(j))
+        def _():
+            pltpu.make_async_copy(
+                scratch.at[pl.ds(j * SLOTS, SLOTS)],
+                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                sem_out.at[j]).start()
+        return c
+
+    lax.fori_loop(0, TILE, issue_out, 0)
+
+    def wait_out(j, c):
+        @pl.when(first_live(j))
+        def _():
+            pltpu.make_async_copy(
+                scratch.at[pl.ds(j * SLOTS, SLOTS)],
+                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                sem_out.at[j]).wait()
+        return c
+
+    lax.fori_loop(0, TILE, wait_out, 0)
+
+
+def _call_kernel(rows, cols, interpret: bool):
+    """cols: 16 int32 arrays shaped [G, TILE] (see _kernel order)."""
+    G = cols[0].shape[0]
+    smem_tile = pl.BlockSpec((1, TILE), lambda i: (i, 0),
+                             memory_space=pltpu.SMEM)
+    out_tile = pl.BlockSpec((1, TILE), lambda i: (i, 0),
+                            memory_space=pltpu.SMEM)
+    table_spec = pl.BlockSpec(memory_space=pl.ANY)
+    o32 = jax.ShapeDtypeStruct((G, TILE), jnp.int32)
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _kernel,
+            grid=(G,),
+            in_specs=[smem_tile] * 16 + [table_spec],
+            out_specs=[table_spec] + [out_tile] * 6,
+            out_shape=[jax.ShapeDtypeStruct(rows.shape, jnp.int32)]
+            + [o32] * 6,
+            input_output_aliases={16: 0},
+            scratch_shapes=[
+                pltpu.VMEM((TILE * SLOTS, WORDS), jnp.int32),
+                pltpu.SemaphoreType.DMA((TILE,)),
+                pltpu.SemaphoreType.DMA((TILE,)),
+            ],
+            interpret=interpret,
+        )(*cols, rows)
+
+
+@partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
+def decide_batch_pallas(table: PallasTable, batch: RequestBatch, now_ms,
+                        *, interpret: bool = False
+                        ) -> tuple[PallasTable, StepOutput]:
+    """Apply one TOKEN_BUCKET batch to the Pallas table.
+
+    Same contract as core/step.py › decide_batch for batches inside
+    the kernel's domain (``pallas_qualifies``) — the parity tests
+    assert identical decisions on shared request streams.  The table
+    buffer is donated (aliased in/out) like decide_batch_donated.
+    """
+    i32, i64 = jnp.int32, jnp.int64
+    cap = table.rows.shape[0]
+    n_buckets = cap // SLOTS
+    B = batch.key.shape[0]
+    G = -(-B // TILE)
+    pad = G * TILE - B
+
+    now = jnp.asarray(now_ms, i64)
+    if batch.now is None:
+        now_col = jnp.full((B,), now, i64)
+    else:
+        now_col = jnp.where(jnp.asarray(batch.now, i64) > 0,
+                            jnp.asarray(batch.now, i64), now)
+
+    key = batch.key.astype(jnp.uint64)
+    valid = (batch.valid & (key != 0)).astype(i32)
+    bucket = (key & jnp.uint64(n_buckets - 1)).astype(i32) * SLOTS
+
+    def pad_to(x, fill=0):
+        return jnp.pad(x, (0, pad), constant_values=fill) if pad else x
+
+    khi, klo = _split64(key)
+    dhi, dlo = _split64(batch.duration.astype(i64))
+    ehi, elo = _split64(batch.eff_ms.astype(i64))
+    ghi, glo = _split64(batch.greg_end.astype(i64))
+    nhi, nlo = _split64(now_col)
+
+    bb = pad_to(bucket)
+    cols1d = [
+        bb,
+        klo, khi,
+        batch.hits.astype(i32), batch.limit.astype(i32),
+        dlo, dhi, elo, ehi, glo, ghi,
+        batch.behavior.astype(i32), nlo, nhi, valid,
+    ]
+    cols1d = [bb] + [pad_to(c) for c in cols1d[1:]]
+
+    # tile-relative first occurrence of each bucket (dedup map): the
+    # kernel's serial loop routes same-bucket requests to one VMEM
+    # copy.  Invalid rows get a UNIQUE sentinel so they can never
+    # become a bucket's representative: first_live gates the DMA on
+    # valid, so an invalid representative would starve a later valid
+    # same-bucket request of its gather/writeback entirely.
+    bt = bb.reshape(G, TILE)
+    iota = jnp.arange(G * TILE, dtype=jnp.int64).reshape(G, TILE)
+    vpad = pad_to(valid).reshape(G, TILE).astype(bool)
+    rep_key = jnp.where(vpad, bt.astype(jnp.int64), -1 - iota)
+    eq = rep_key[:, :, None] == rep_key[:, None, :]
+    brep = jnp.argmax(eq, axis=-1).astype(i32)  # first True per row
+
+    cols = [bt, brep] + [c.reshape(G, TILE) for c in cols1d[1:]]
+    rows2, st, rem, rlo, rhi, lim, flg = _call_kernel(
+        table.rows, cols, interpret)
+
+    def unpad(x):
+        return x.reshape(-1)[:B]
+
+    st = unpad(st)
+    flg = unpad(flg)
+    err = (flg & 1) != 0
+    vb = valid.astype(bool)[:B] if pad else valid.astype(bool)
+    live = vb & (~err)
+    status = jnp.where(live, st, 0)
+    remaining = jnp.where(live, unpad(rem).astype(i64), 0)
+    reset_time = jnp.where(
+        live, _join64(unpad(rhi), unpad(rlo), i64), 0)
+    limit_out = jnp.where(live, unpad(lim).astype(i64), 0)
+    over = (live & (status == 1)).sum(dtype=i64)
+    inserts = ((flg >> 1) & 1).sum(dtype=i64)
+    return PallasTable(rows=rows2), StepOutput(
+        status=status.astype(i32), remaining=remaining,
+        reset_time=reset_time, limit=limit_out,
+        err=vb & err, over_count=over, insert_count=inserts)

@@ -1,0 +1,248 @@
+"""Bit-parity of the Pallas decision-step kernel (interpret mode) vs
+the XLA step on shared TOKEN_BUCKET request streams.
+
+The kernel owns its table layout (bucketized AoS vs the XLA SoA), so
+parity is asserted on DECISIONS (status/remaining/reset/limit/err) and
+on the aggregate counters — exactly the contract the oracle-parity
+suite pins for the XLA step itself (tests/test_step_parity.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gubernator_tpu.core.batch import RequestBatch
+from gubernator_tpu.core.step import decide_batch
+from gubernator_tpu.core.table import init_table
+from gubernator_tpu.ops.pallas_step import (SLOTS, VALUE_BOUND,
+                                            decide_batch_pallas,
+                                            init_pallas_table,
+                                            pallas_qualifies)
+from gubernator_tpu.types import Behavior
+
+i64, i32 = jnp.int64, jnp.int32
+NOW = 1_760_000_000_000
+FIELDS = ("status", "remaining", "reset_time", "limit", "err")
+
+
+def mk_batch(keys, **over):
+    B = len(keys)
+    cols = dict(
+        key=jnp.asarray(np.asarray(keys, np.uint64)),
+        hits=jnp.ones(B, i64), limit=jnp.full(B, 10, i64),
+        duration=jnp.full(B, 10_000, i64),
+        eff_ms=jnp.full(B, 10_000, i64), greg_end=jnp.zeros(B, i64),
+        behavior=jnp.zeros(B, i32), algorithm=jnp.zeros(B, i32),
+        burst=jnp.full(B, 10, i64), valid=jnp.ones(B, bool),
+        now=jnp.zeros(B, i64))
+    cols.update(over)
+    return RequestBatch(**cols)
+
+
+def keyify(ids):
+    k = (np.asarray(ids, np.uint64) + np.uint64(1)) \
+        * np.uint64(0x9E3779B97F4A7C15)
+    return np.where(k == 0, np.uint64(1), k)
+
+
+def run_both(batches, nows, cap=1 << 12):
+    pt, st = init_pallas_table(cap), init_table(cap)
+    for b, now in zip(batches, nows):
+        assert pallas_qualifies(b)
+        pt, po = decide_batch_pallas(pt, b, jnp.asarray(now, i64),
+                                     interpret=True)
+        st, xo = decide_batch(st, b, jnp.asarray(now, i64))
+        for f in FIELDS:
+            a, c = np.asarray(getattr(po, f)), np.asarray(getattr(xo, f))
+            assert (a == c).all(), \
+                (f, np.nonzero(a != c)[0][:5].tolist())
+        assert int(po.over_count) == int(xo.over_count)
+        assert int(po.insert_count) == int(xo.insert_count)
+    return pt, st
+
+
+class TestPallasStepParity:
+    def test_zipf_duplicates_multi_batch(self):
+        rng = np.random.default_rng(1)
+        batches, nows = [], []
+        for w in range(6):
+            ids = rng.zipf(1.3, size=512) % 200
+            hits = rng.integers(0, 4, size=512)  # includes queries
+            batches.append(mk_batch(keyify(ids),
+                                    hits=jnp.asarray(hits, i64)))
+            nows.append(NOW + w * 700)
+        run_both(batches, nows)
+
+    def test_expiry_and_refresh(self):
+        keys = keyify(np.arange(64))
+        batches = [mk_batch(keys, hits=jnp.full(64, 3, i64)),
+                   mk_batch(keys, hits=jnp.full(64, 3, i64)),
+                   # past expiry: buckets refresh
+                   mk_batch(keys, hits=jnp.full(64, 3, i64))]
+        run_both(batches, [NOW, NOW + 5_000, NOW + 25_000])
+
+    def test_limit_and_duration_change_in_place(self):
+        keys = keyify(np.arange(40))
+        b1 = mk_batch(keys, hits=jnp.full(40, 4, i64))
+        b2 = mk_batch(keys, limit=jnp.full(40, 25, i64))  # limit up
+        b3 = mk_batch(keys, limit=jnp.full(40, 25, i64),
+                      duration=jnp.full(40, 60_000, i64),
+                      eff_ms=jnp.full(40, 60_000, i64))  # duration change
+        b4 = mk_batch(keys, limit=jnp.full(40, 3, i64))  # limit down
+        run_both([b1, b2, b3, b4],
+                 [NOW, NOW + 100, NOW + 200, NOW + 300])
+
+    def test_reset_and_drain_flags(self):
+        rng = np.random.default_rng(2)
+        keys = keyify(rng.integers(0, 30, size=256))
+        beh = np.zeros(256, np.int32)
+        beh[::7] = int(Behavior.RESET_REMAINING)
+        beh[3::11] = int(Behavior.DRAIN_OVER_LIMIT)
+        hits = rng.integers(0, 6, size=256)
+        batches = [mk_batch(keys, hits=jnp.asarray(hits, i64),
+                            behavior=jnp.asarray(beh))
+                   for _ in range(3)]
+        run_both(batches, [NOW, NOW + 50, NOW + 90])
+
+    def test_gregorian_expiry_column(self):
+        keys = keyify(np.arange(32))
+        greg = np.full(32, NOW + 3_600_000, np.int64)
+        beh = np.full(32, int(Behavior.DURATION_IS_GREGORIAN), np.int32)
+        b = mk_batch(keys, behavior=jnp.asarray(beh),
+                     greg_end=jnp.asarray(greg),
+                     eff_ms=jnp.full(32, 3_600_000, i64))
+        b2 = mk_batch(keys, behavior=jnp.asarray(beh),
+                      greg_end=jnp.asarray(greg + 3_600_000),
+                      eff_ms=jnp.full(32, 3_600_000, i64))
+        # second batch past the boundary: fresh window adopts new end
+        run_both([b, b, b2], [NOW, NOW + 1000, NOW + 3_700_000])
+
+    def test_mixed_per_request_now(self):
+        rng = np.random.default_rng(3)
+        keys = keyify(rng.integers(0, 20, size=256))
+        nows = NOW + rng.integers(0, 3_000, size=256).astype(np.int64)
+        b = mk_batch(keys, now=jnp.asarray(nows, i64))
+        # XLA path orders by (row, now); the kernel applies in batch
+        # order — parity requires per-key-sorted arrival, so sort the
+        # batch by (key, now) first, which preserves per-key time order
+        order = np.lexsort((np.asarray(nows), np.asarray(b.key)))
+        b = RequestBatch(*[jnp.asarray(np.asarray(c)[order]) for c in b])
+        run_both([b], [NOW + 5_000])
+
+    def test_invalid_rows_masked(self):
+        keys = keyify(np.arange(64))
+        valid = np.ones(64, bool)
+        valid[10:20] = False
+        b = mk_batch(keys, valid=jnp.asarray(valid))
+        pt, po = decide_batch_pallas(init_pallas_table(1 << 10), b,
+                                     jnp.asarray(NOW, i64),
+                                     interpret=True)
+        assert (np.asarray(po.status)[10:20] == 0).all()
+        assert (np.asarray(po.remaining)[10:20] == 0).all()
+        st, xo = decide_batch(init_table(1 << 10), b,
+                              jnp.asarray(NOW, i64))
+        for f in FIELDS:
+            assert (np.asarray(getattr(po, f))
+                    == np.asarray(getattr(xo, f))).all(), f
+
+    def test_invalid_first_occupant_does_not_starve_bucket(self):
+        """An invalid row that would be a bucket's tile-first occurrence
+        must not become its representative: the later VALID same-bucket
+        request still gets a real gather + decision + writeback."""
+        keys = keyify(np.arange(1, 9))
+        # row 0: invalid, same key (→ same bucket) as valid row 5
+        key_col = np.concatenate([[np.asarray(keys)[5]], keys[:8]])
+        valid = np.ones(9, bool)
+        valid[0] = False
+        b = mk_batch(key_col, valid=jnp.asarray(valid),
+                     hits=jnp.full(9, 4, i64))
+        pt, po = decide_batch_pallas(init_pallas_table(1 << 10), b,
+                                     jnp.asarray(NOW, i64),
+                                     interpret=True)
+        st, xo = decide_batch(init_table(1 << 10), b,
+                              jnp.asarray(NOW, i64))
+        for f in FIELDS:
+            assert (np.asarray(getattr(po, f))
+                    == np.asarray(getattr(xo, f))).all(), f
+        # and the debit persisted to the table
+        b2 = mk_batch(key_col, valid=jnp.asarray(valid),
+                      hits=jnp.zeros(9, i64))
+        pt, po2 = decide_batch_pallas(pt, b2, jnp.asarray(NOW + 1, i64),
+                                      interpret=True)
+        assert int(po2.remaining[6]) == 6  # 10 - 4, row persisted
+
+    def test_bucket_full_errors_without_corruption(self):
+        """> SLOTS distinct keys forced into one bucket: the overflow
+        keys err ('table full' contract), the resident keys still
+        serve correctly."""
+        cap = 256
+        nb = cap // SLOTS
+        # same low bits → same bucket; distinct high bits
+        keys = np.array([(j << 40) | 5 for j in range(1, SLOTS + 4)],
+                        np.uint64)
+        b = mk_batch(keys)
+        pt = init_pallas_table(cap)
+        pt, po = decide_batch_pallas(pt, b, jnp.asarray(NOW, i64),
+                                     interpret=True)
+        err = np.asarray(po.err)
+        assert err.sum() == 3  # 11 keys, 8 slots
+        assert (np.asarray(po.status)[~err] == 0).all()
+        assert (np.asarray(po.remaining)[~err] == 9).all()
+        # the survivors keep serving (their state was not clobbered)
+        pt, po2 = decide_batch_pallas(pt, b, jnp.asarray(NOW + 1, i64),
+                                      interpret=True)
+        assert (np.asarray(po2.remaining)[~np.asarray(po2.err)] == 8).all()
+
+    def test_sustained_stream_parity(self):
+        """Longer adversarial stream: hot keys, queries, flag churn,
+        limit churn, expiry windows — 10 sequential batches."""
+        rng = np.random.default_rng(7)
+        batches, nows = [], []
+        t = NOW
+        for w in range(10):
+            n = 384
+            ids = rng.zipf(1.2, size=n) % 100
+            hits = rng.integers(0, 5, size=n)
+            lim = np.full(n, 10 + (w % 3) * 5, np.int64)
+            beh = np.where(rng.random(n) < 0.05,
+                           int(Behavior.RESET_REMAINING), 0)
+            beh = np.where(rng.random(n) < 0.05,
+                           beh | int(Behavior.DRAIN_OVER_LIMIT), beh)
+            batches.append(mk_batch(
+                keyify(ids), hits=jnp.asarray(hits, i64),
+                limit=jnp.asarray(lim),
+                behavior=jnp.asarray(beh.astype(np.int32))))
+            t += int(rng.integers(0, 6_000))
+            nows.append(t)
+        run_both(batches, nows)
+
+
+class TestQualifier:
+    def test_rejects_leaky_and_big_values(self):
+        keys = keyify(np.arange(8))
+        assert pallas_qualifies(mk_batch(keys))
+        assert not pallas_qualifies(
+            mk_batch(keys, algorithm=jnp.ones(8, i32)))
+        assert not pallas_qualifies(
+            mk_batch(keys, limit=jnp.full(8, VALUE_BOUND, i64)))
+        assert not pallas_qualifies(
+            mk_batch(keys, hits=jnp.full(8, -1, i64)))
+        # invalid rows don't disqualify (they're masked anyway)
+        leaky_invalid = mk_batch(
+            keys, algorithm=jnp.ones(8, i32),
+            valid=jnp.zeros(8, bool))
+        assert pallas_qualifies(leaky_invalid)
+
+    def test_rejects_time_inverted_duplicates(self):
+        """Same key with DECREASING now in batch order serializes
+        differently in the kernel (batch order) than in the XLA path
+        (arrival order) — the qualifier must route it to XLA."""
+        keys = keyify(np.array([1, 2, 1]))
+        nows = np.array([NOW + 100, NOW, NOW + 50], np.int64)
+        assert not pallas_qualifies(
+            mk_batch(keys, now=jnp.asarray(nows, i64)))
+        # sorted per key: qualifies
+        nows_ok = np.array([NOW, NOW + 50, NOW + 100], np.int64)
+        assert pallas_qualifies(
+            mk_batch(keyify(np.array([1, 1, 2])),
+                     now=jnp.asarray(nows_ok, i64)))

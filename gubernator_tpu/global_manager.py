@@ -102,16 +102,16 @@ class GlobalManager:
     def queue_hits_raw(self, khash: int, tlv: bytes, hits: int) -> None:
         """Wire-lane twin of ``queue_hits``: accumulate ``hits`` for the
         key identified by ``khash``, with ``tlv`` (the verbatim
-        GetRateLimitsReq.requests TLV slice) as the deferred prototype."""
-        if hits <= 0:
-            return
+        GetRateLimitsReq.requests TLV slice) as the deferred prototype.
+        A hits=0 entry still refreshes the prototype, exactly as
+        queue_hits stores the latest req unconditionally."""
         with self._mu:
             self._seq += 1
             _, acc, _ = self._hits_raw.get(khash, (tlv, 0, 0))
             # keep the LATEST tlv as the prototype, exactly as
             # queue_hits keeps the latest req: a mid-window config
             # change must reconcile under the new limit/duration
-            self._hits_raw[khash] = (tlv, acc + hits, self._seq)
+            self._hits_raw[khash] = (tlv, acc + max(hits, 0), self._seq)
             n = len(self._hits_raw) + len(self._hits)
         self.metrics.queue_length.set(n)
         if n >= self.behaviors.global_batch_limit:
@@ -128,20 +128,10 @@ class GlobalManager:
 
     @staticmethod
     def _req_from_tlv(tlv: bytes) -> RateLimitRequest:
-        """Deferred prototype: TLV slice (tag byte + varint length +
-        RateLimitReq payload) → request object.  Flush-cadence only."""
-        from .proto import gubernator_pb2 as pb
-        from .wire import req_from_pb
+        """Deferred prototype (wire.req_from_tlv).  Flush-cadence only."""
+        from .wire import req_from_tlv
 
-        i, shift, ln = 1, 0, 0
-        while True:
-            b = tlv[i]
-            ln |= (b & 0x7F) << shift
-            i += 1
-            if not b & 0x80:
-                break
-            shift += 7
-        return req_from_pb(pb.RateLimitReq.FromString(tlv[i:i + ln]))
+        return req_from_tlv(tlv)
 
     # ---- async loops ---------------------------------------------------
 

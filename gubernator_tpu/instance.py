@@ -350,8 +350,6 @@ class V1Instance:
         finally:
             self.metrics.concurrent_checks.dec()
 
-    _FAST_EXCLUDED = int(Behavior.GLOBAL) | int(Behavior.MULTI_REGION)
-
     def get_rate_limits_wire(self, data: bytes,
                              now_ms: Optional[int] = None) -> bytes:
         """Wire-to-wire GetRateLimits: serialized GetRateLimitsReq in,
@@ -360,18 +358,20 @@ class V1Instance:
         Takes the C++ columnar fast lane (ops/_native.cpp: wire bytes →
         packed arrays → one device step → wire bytes, zero per-request
         Python objects) when the batch qualifies: extension built, no
-        Store hooks, no MULTI_REGION behaviors, no metadata, non-empty
-        names/keys.  Solo (no peers beyond self): GLOBAL batches ride a
-        columnar hot-set flow (pinned keys → replica step, the rest →
-        sharded step + vectorized promotion counting).  Clustered: ALL
-        batches ride the clustered columnar lane — non-GLOBAL rows are
-        ring-split by owner (owned keys stepped locally, the rest
-        forwarded as raw TLV slices over the peer wire and spliced back
-        in order); GLOBAL rows are answered from the local replica with
-        async reconcile queued as raw TLV prototypes
-        (_wire_check_clustered).  Anything the lanes can't model falls
-        back to the pb2 object path with identical semantics.  Raises
-        ValueError on oversize batches (mirroring ``get_rate_limits``).
+        Store hooks, no metadata, non-empty names/keys.  Solo (no peers
+        beyond self): GLOBAL batches ride a columnar hot-set flow
+        (pinned keys → replica step, the rest → sharded step +
+        vectorized promotion counting).  Clustered: ALL batches ride
+        the clustered columnar lane — non-GLOBAL rows are ring-split by
+        owner (owned keys stepped locally, the rest forwarded as raw
+        TLV slices over the peer wire and spliced back in order);
+        GLOBAL rows are answered from the local replica with async
+        reconcile queued as raw TLV prototypes (_wire_check_clustered).
+        MULTI_REGION rows decided locally queue cross-region
+        replication the same way (multiregion.queue_hits_raw, after
+        the step).  Anything the lanes can't model falls back to the
+        pb2 object path with identical semantics.  Raises ValueError
+        on oversize batches (mirroring ``get_rate_limits``).
         """
         parsed = None
         is_global = False
@@ -379,24 +379,21 @@ class V1Instance:
         if _wire_native is not None and self.store is None:
             parsed = _wire_native.parse_get_rate_limits(data)
             if parsed is not None:
-                if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
-                    parsed = None
-                else:
-                    is_global = bool(parsed["behavior_or"]
-                                     & int(Behavior.GLOBAL))
-                    peer_list = self.peers()
-                    solo = not peer_list or all(
-                        self.is_self(p) for p in peer_list)
-                    if not solo:
-                        # clustered GLOBAL rides the same columnar lane:
-                        # GLOBAL rows are answered from the local
-                        # replica and their reconcile queues take raw
-                        # TLV slices (global_manager.queue_*_raw), so
-                        # no per-request objects are needed
-                        clustered = True
-                    # solo GLOBAL rides the columnar hot-set flow; the
-                    # object path's queue_update is a no-op with no
-                    # peers (nothing to broadcast to)
+                is_global = bool(parsed["behavior_or"]
+                                 & int(Behavior.GLOBAL))
+                peer_list = self.peers()
+                solo = not peer_list or all(
+                    self.is_self(p) for p in peer_list)
+                if not solo:
+                    # clustered GLOBAL rides the same columnar lane:
+                    # GLOBAL rows are answered from the local replica
+                    # and their reconcile queues take raw TLV slices
+                    # (global_manager.queue_*_raw), so no per-request
+                    # objects are needed
+                    clustered = True
+                # solo GLOBAL rides the columnar hot-set flow; the
+                # object path's queue_update is a no-op with no peers
+                # (nothing to broadcast to)
         if parsed is not None:
             n = parsed["n"]
             if n > MAX_BATCH_SIZE:
@@ -410,13 +407,30 @@ class V1Instance:
                 lane = "wire_clustered"
                 runner = lambda: self._wire_check_clustered(  # noqa: E731
                     parsed, data, now)
-            elif is_global:
-                lane = "wire_hotset"
-                runner = self._wire_global_runner(parsed, now)
             else:
-                lane = "wire_local"
-                runner = lambda: self._wire_check_columns(  # noqa: E731
-                    parsed, now)
+                # MULTI_REGION rows decided locally replicate
+                # cross-region asynchronously; GLOBAL takes precedence
+                # (the object path never MR-queues a GLOBAL row).
+                # Solo: every row is local.  (The clustered lane
+                # derives its own owned-rows mask.)
+                mr_mask = ((parsed["behavior"]
+                            & int(Behavior.MULTI_REGION)) != 0) & \
+                    ((parsed["behavior"] & int(Behavior.GLOBAL)) == 0)
+                if is_global:
+                    lane = "wire_hotset"
+                    inner = self._wire_global_runner(parsed, now)
+                else:
+                    lane = "wire_local"
+                    inner = lambda: self._wire_check_columns(  # noqa: E731
+                        parsed, now)
+                if inner is not None and mr_mask.any():
+                    def runner(inner=inner):
+                        out = inner()
+                        # after the step: rows exist, replicate async
+                        self._queue_mr_raw(parsed, data, mr_mask)
+                        return out
+                else:
+                    runner = inner
             if runner is not None:
                 self.metrics.getratelimit_counter.labels(
                     calltype="api").inc(n)
@@ -455,14 +469,16 @@ class V1Instance:
         forwarding (peers.proto uses the same RateLimitReq/RateLimitResp
         submessages on field 1, so the C++ codec applies verbatim).
         Forwarded batches always apply locally, so peer membership does
-        not gate the fast lane; GLOBAL/MULTI_REGION batches still fall
-        back (they queue broadcast/replication work per request)."""
+        not gate the fast lane.  GLOBAL rows mark their keys changed
+        for the next broadcast tick (queue_update_raw — this is the
+        owner applying reconciled hits) and MULTI_REGION rows queue
+        cross-region replication (queue_hits_raw), both AFTER the step,
+        aggregated per unique key with raw TLV prototypes — the
+        columnar twins of the per-request queueing the object path
+        does."""
         parsed = None
         if _wire_native is not None and self.store is None:
             parsed = _wire_native.parse_get_rate_limits(data)
-            if parsed is not None and (
-                    parsed["behavior_or"] & self._FAST_EXCLUDED):
-                parsed = None
         if parsed is None:
             from google.protobuf.message import DecodeError
 
@@ -489,7 +505,58 @@ class V1Instance:
             parsed["n"])
         self.metrics.wire_lane_counter.labels(lane="peer_wire").inc(
             parsed["n"])
-        return self._wire_check_columns(parsed, now)
+        out = self._wire_check_columns(parsed, now)
+        beh = parsed["behavior"]
+        glob = (beh & int(Behavior.GLOBAL)) != 0
+        if glob.any():
+            self._queue_global_updates_raw(parsed, data, glob)
+        # NO GLOBAL precedence here: the object path's peer handler
+        # queues BOTH for a GLOBAL|MULTI_REGION row (two independent
+        # per-request ifs), unlike the client path
+        mr = (beh & int(Behavior.MULTI_REGION)) != 0
+        if mr.any():
+            self._queue_mr_raw(parsed, data, mr)
+        return out
+
+    @staticmethod
+    def _raw_queue_groups(parsed: dict, data: bytes, mask: np.ndarray):
+        """(khash, last-occurrence TLV, summed hits, last row index)
+        per unique masked key — the shared aggregation for the raw
+        async queues (LAST occurrence: a mid-batch config change must
+        win, matching the object-path producers)."""
+        idx = np.nonzero(mask)[0]
+        if not idx.size:
+            return
+        toff, tlen = parsed["tlv_off"], parsed["tlv_len"]
+        w = np.maximum(parsed["hits"][idx], 0)
+        uniq, inv = np.unique(parsed["khash_raw"][idx],
+                              return_inverse=True)
+        acc = np.bincount(inv, weights=w).astype(np.int64)
+        last = np.zeros(uniq.size, np.int64)
+        last[inv] = np.arange(inv.size)
+        for k, f, a in zip(uniq, last, acc):
+            i = int(idx[int(f)])
+            yield (int(k),
+                   bytes(data[int(toff[i]):int(toff[i] + tlen[i])]),
+                   int(a), i)
+
+    def _queue_mr_raw(self, parsed: dict, data: bytes,
+                      mask: np.ndarray) -> None:
+        """Queue cross-region replication for locally-decided
+        MULTI_REGION rows, zero per-request objects (the wire-lane twin
+        of the object path's mr.queue_hits calls)."""
+        mr = self._ensure_mr_manager()
+        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask):
+            mr.queue_hits_raw(k, tlv, a)
+
+    def _queue_global_updates_raw(self, parsed: dict, data: bytes,
+                                  mask: np.ndarray) -> None:
+        """Owner side of forwarded GLOBAL rows: mark each unique key
+        changed for the next broadcast tick (queue_update_raw), as
+        get_peer_rate_limits does per request on the object path."""
+        gm = self._ensure_global_manager()
+        for k, tlv, _a, _i in self._raw_queue_groups(parsed, data, mask):
+            gm.queue_update_raw(k, tlv)
 
     def _wire_global_runner(self, parsed: dict, now: int):
         """Columnar solo-GLOBAL flow (the wire-lane twin of
@@ -694,21 +761,13 @@ class V1Instance:
             # broadcast tick firing in between would gather a row that
             # doesn't exist yet and silently drop the update (observed
             # as a cold-compile-window flake).
-            gidx = np.nonzero(glob_mask)[0]
-            w = np.maximum(parsed["hits"][gidx], 0)
-            uniq, inv = np.unique(raw[gidx], return_inverse=True)
-            acc = np.bincount(inv, weights=w).astype(np.int64)
-            self_owned = np.isin(owners[gidx], self_pi)
-            # LAST occurrence per unique key is the prototype — a
-            # mid-batch config change must reconcile under the new
-            # limit/duration, matching queue_hits (latest req wins)
-            last = np.zeros(uniq.size, np.int64)
-            last[inv] = np.arange(inv.size)
-            for k, f, a in zip(uniq, last, acc):
-                i = int(gidx[int(f)])
-                tlv = bytes(data[int(toff[i]):int(toff[i] + tlen[i])])
+            # shared aggregation (_raw_queue_groups): unmixed-khash
+            # queue keys — the same key space as the peer-wire
+            # producers — with last-occurrence TLV prototypes
+            for k, tlv, a, i in self._raw_queue_groups(
+                    parsed, data, glob_mask):
                 glob_queue.append(
-                    (int(k), tlv, int(a), bool(self_owned[int(f)])))
+                    (k, tlv, a, int(owners[i]) in self_pi))
             local_mask = local_mask | glob_mask
         item_tlvs: List[Optional[bytes]] = [None] * n
 
@@ -752,6 +811,14 @@ class V1Instance:
                     gm.queue_update_raw(k, tlv)
                 else:
                     gm.queue_hits_raw(k, tlv, a)
+        # locally-OWNED MULTI_REGION rows replicate cross-region async
+        # (forwarded MR rows are queued by their owner; GLOBAL rows
+        # never MR-queue — object-path precedence)
+        mr_mask = (np.isin(owners, self_pi) & (~glob_mask)
+                   & ((parsed["behavior"]
+                       & int(Behavior.MULTI_REGION)) != 0))
+        if mr_mask.any():
+            self._queue_mr_raw(parsed, data, mr_mask)
 
         for idxs, fut, send_err in groups:
             rbytes, err, sp = None, send_err, None

@@ -40,8 +40,16 @@ class MultiRegionManager:
         self.instance = instance
         self.behaviors = behaviors
         self._mu = threading.Lock()
-        #: key → (request prototype, accumulated hits)
-        self._hits: Dict[str, Tuple[RateLimitRequest, int]] = {}
+        #: cross-lane arrival order (under _mu) — the prototype with the
+        #: highest seq wins the flush-time merge (latest config wins
+        #: across the object and wire lanes, as in GlobalManager)
+        self._seq = 0
+        #: key → (request prototype, accumulated hits, seq)
+        self._hits: Dict[str, Tuple[RateLimitRequest, int, int]] = {}
+        #: key-hash → (request TLV bytes, accumulated hits, seq) — the
+        #: columnar wire lanes queue raw `requests` TLV slices;
+        #: materialized via wire.req_from_tlv at flush cadence
+        self._hits_raw: Dict[int, Tuple[bytes, int, int]] = {}
         self._err_mu = threading.Lock()
         self._last_error = ""
         self._last_error_at = 0.0
@@ -68,9 +76,25 @@ class MultiRegionManager:
     def queue_hits(self, req: RateLimitRequest) -> None:
         """reference: mutliregion.go › QueueHits."""
         with self._mu:
-            proto, acc = self._hits.get(req.key, (req, 0))
-            self._hits[req.key] = (req, acc + max(int(req.hits), 0))
-            n = len(self._hits)
+            self._seq += 1
+            _, acc, _ = self._hits.get(req.key, (req, 0, 0))
+            self._hits[req.key] = (req, acc + max(int(req.hits), 0),
+                                   self._seq)
+            n = len(self._hits) + len(self._hits_raw)
+        if n >= self.behaviors.multi_region_batch_limit:
+            self._loop.poke()
+
+    def queue_hits_raw(self, khash: int, tlv: bytes, hits: int) -> None:
+        """Wire-lane twin of ``queue_hits``: raw TLV prototype +
+        aggregated hits per unique key, no per-request objects.  A
+        hits=0 entry still refreshes the prototype — queue_hits stores
+        the latest req unconditionally, and a query carrying a config
+        change must win the flush-time merge the same way."""
+        with self._mu:
+            self._seq += 1
+            _, acc, _ = self._hits_raw.get(khash, (tlv, 0, 0))
+            self._hits_raw[khash] = (tlv, acc + max(hits, 0), self._seq)
+            n = len(self._hits) + len(self._hits_raw)
         if n >= self.behaviors.multi_region_batch_limit:
             self._loop.poke()
 
@@ -79,6 +103,19 @@ class MultiRegionManager:
         reference: mutliregion.go › runAsyncReqs."""
         with self._mu:
             hits, self._hits = self._hits, {}
+            hits_raw, self._hits_raw = self._hits_raw, {}
+        from .wire import req_from_tlv
+
+        for khash, (tlv, acc, seq) in hits_raw.items():
+            try:
+                req = req_from_tlv(tlv)
+            except Exception:  # noqa: BLE001 - parser-bug guard
+                log.warning("dropping unparseable queued TLV for key "
+                            "hash %d", khash)
+                continue
+            proto, a0, s0 = hits.get(req.key, (req, 0, seq))
+            hits[req.key] = (req if seq >= s0 else proto, a0 + acc,
+                             max(s0, seq))
         if not hits:
             return  # no attempts: leave the error state as-is (TTL expires it)
         local_dc = self.instance.config.data_center
@@ -88,7 +125,7 @@ class MultiRegionManager:
             if dc == local_dc:
                 continue
             by_peer: Dict[str, Tuple[object, list]] = {}
-            for key, (req, acc) in hits.items():
+            for key, (req, acc, _seq) in hits.items():
                 if acc <= 0:
                     continue
                 try:

@@ -41,6 +41,25 @@ def req_from_pb(m: pb.RateLimitReq) -> RateLimitRequest:
         burst=m.burst, metadata=dict(m.metadata) if m.metadata else {})
 
 
+def req_from_tlv(tlv: bytes) -> RateLimitRequest:
+    """Deferred request prototype: a verbatim `requests` TLV slice
+    (tag byte 0x0a + varint length + RateLimitReq payload) → object.
+
+    The columnar wire lanes queue raw TLV slices for async reconcile
+    (GLOBAL) and cross-region replication (MULTI_REGION) instead of
+    building per-request objects on the hot path; the managers call
+    this at flush cadence."""
+    i, shift, ln = 1, 0, 0
+    while True:
+        b = tlv[i]
+        ln |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            break
+        shift += 7
+    return req_from_pb(pb.RateLimitReq.FromString(tlv[i:i + ln]))
+
+
 def resp_to_pb(r: RateLimitResponse) -> pb.RateLimitResp:
     m = pb.RateLimitResp(
         status=int(r.status), limit=int(r.limit), remaining=int(r.remaining),

@@ -217,6 +217,77 @@ class TestPallasStepParity:
         run_both(batches, nows)
 
 
+class TestPropertyParity:
+    """Hypothesis fuzz: ANY token stream inside the kernel's domain
+    must match the XLA step exactly (same pattern as
+    test_property_parity.py, scaled by GUBER_FUZZ_X)."""
+
+    def test_any_token_stream_matches_xla(self):
+        import os as _os
+
+        from hypothesis import HealthCheck, given, settings
+        from hypothesis import strategies as st
+
+        _FX = int(_os.environ.get("GUBER_FUZZ_X", "1"))
+
+        _beh = st.sampled_from([0, int(Behavior.RESET_REMAINING),
+                                int(Behavior.DRAIN_OVER_LIMIT),
+                                int(Behavior.RESET_REMAINING
+                                    | Behavior.DRAIN_OVER_LIMIT)])
+        _row = st.tuples(
+            st.integers(0, 11),     # key id (forced dups)
+            st.integers(0, 6),      # hits
+            st.integers(0, 30),     # limit
+            st.integers(1, 50_000),  # duration
+            _beh,
+        )
+        _stream = st.lists(
+            st.tuples(st.lists(_row, min_size=1, max_size=32),
+                      st.integers(0, 40_000)),
+            min_size=1, max_size=4)
+
+        B = 32  # fixed batch shape → one compiled program per mode
+
+        @settings(max_examples=_FX * 15, deadline=None,
+                  suppress_health_check=[HealthCheck.too_slow])
+        @given(_stream)
+        def run(stream):
+            pt, st_x = init_pallas_table(1 << 9), init_table(1 << 9)
+            now = NOW
+            for rows, dt in stream:
+                now += dt
+                n = len(rows)
+                ids = np.array([r[0] for r in rows])
+                pad = B - n
+                b = mk_batch(
+                    np.pad(keyify(ids), (0, pad), constant_values=1),
+                    hits=jnp.asarray(np.pad(
+                        [r[1] for r in rows], (0, pad)), i64),
+                    limit=jnp.asarray(np.pad(
+                        [r[2] for r in rows], (0, pad)), i64),
+                    duration=jnp.asarray(np.pad(
+                        [r[3] for r in rows], (0, pad),
+                        constant_values=1), i64),
+                    eff_ms=jnp.asarray(np.pad(
+                        [r[3] for r in rows], (0, pad),
+                        constant_values=1), i64),
+                    behavior=jnp.asarray(np.pad(
+                        [r[4] for r in rows], (0, pad)).astype(np.int32)),
+                    valid=jnp.asarray(
+                        np.arange(B) < n))
+                assert pallas_qualifies(b)
+                pt, po = decide_batch_pallas(
+                    pt, b, jnp.asarray(now, i64), interpret=True)
+                st_x, xo = decide_batch(st_x, b, jnp.asarray(now, i64))
+                for f in FIELDS:
+                    a, c = (np.asarray(getattr(po, f)),
+                            np.asarray(getattr(xo, f)))
+                    assert (a == c).all(), \
+                        (f, rows, np.nonzero(a != c)[0].tolist())
+
+        run()
+
+
 class TestQualifier:
     def test_rejects_leaky_and_big_values(self):
         keys = keyify(np.arange(8))

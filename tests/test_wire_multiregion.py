@@ -1,0 +1,154 @@
+"""MULTI_REGION through the columnar wire lanes (round 3): MR batches
+previously demoted the whole batch to the pb2 object path.  These tests
+pin that MR rows now ride `wire_local`/`wire_clustered`/`peer_wire`
+with replication queued as raw TLV prototypes — and that cross-region
+convergence and no-ping-pong semantics are unchanged."""
+import time
+
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+from gubernator_tpu.netutil import free_port
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.types import Behavior, RateLimitRequest
+
+DAY = 86_400_000
+
+
+def ser(reqs):
+    m = pb.GetRateLimitsReq()
+    for r in reqs:
+        q = m.requests.add()
+        q.name, q.unique_key = r.name, r.unique_key
+        q.hits, q.limit, q.duration = r.hits, r.limit, r.duration
+        q.behavior = int(r.behavior)
+    return m.SerializeToString()
+
+
+def mr_req(key, hits=1, name="wmr", behavior=Behavior.MULTI_REGION):
+    return RateLimitRequest(name=name, unique_key=key, hits=hits,
+                            limit=100, duration=DAY, behavior=behavior)
+
+
+def lane(inst, lane_name):
+    return inst.metrics.wire_lane_counter.labels(
+        lane=lane_name)._value.get()
+
+
+def check_wire(inst, reqs, now=None):
+    out = pb.GetRateLimitsResp.FromString(inst.get_rate_limits_wire(
+        ser(reqs), now_ms=now or int(time.time() * 1000)))
+    return list(out.responses)
+
+
+@pytest.fixture(scope="module")
+def regions():
+    behaviors = BehaviorConfig(
+        batch_timeout_ms=30, batch_wait_ms=30,
+        multi_region_sync_wait_ms=50, multi_region_timeout_ms=5000)
+    cfgs = [DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address="", cache_size=1 << 10,
+        data_center="dc-east" if i < 2 else "dc-west",
+        behaviors=behaviors) for i in range(4)]
+    c = cluster_mod.start_with(cfgs, mesh=make_mesh(n=2))
+    yield c
+    c.stop()
+
+
+def _west_remaining(regions, key, name="wmr"):
+    [r] = check_wire(regions.instance_at(2),
+                     [mr_req(key, hits=0, name=name)])
+    return int(r.remaining)
+
+
+def test_mr_rides_columnar_lane_and_converges(regions):
+    """An MR batch through an east daemon's wire entry must take a
+    columnar lane (zero pb2 fallback) and still replicate west."""
+    inst = regions.instance_at(0)
+    fb0 = lane(inst, "pb2_fallback")
+    col0 = lane(inst, "wire_clustered") + lane(inst, "wire_local")
+    key = "wa:1"
+    for _ in range(3):
+        rs = check_wire(inst, [mr_req(key, hits=2)])
+        assert rs[0].error == ""
+    assert lane(inst, "pb2_fallback") == fb0
+    assert (lane(inst, "wire_clustered")
+            + lane(inst, "wire_local")) - col0 == 3
+    # east sees its own hits now; west converges async
+    [r] = check_wire(inst, [mr_req(key, hits=0)])
+    assert int(r.remaining) == 94
+    deadline = time.time() + 6
+    while time.time() < deadline and _west_remaining(regions, key) != 94:
+        time.sleep(0.05)
+    assert _west_remaining(regions, key) == 94
+
+
+def test_mr_forwarded_owner_queues_via_peer_wire(regions):
+    """A key owned by the OTHER east daemon: the serving daemon
+    forwards over the peer wire; the owner's peer-wire lane (not a pb2
+    fallback) must queue the cross-region replication."""
+    inst = regions.instance_at(0)
+    # find a key owned by daemon 1 (east's other daemon)
+    key = None
+    for i in range(200):
+        cand = f"wb:{i}"
+        d = regions.owner_daemon_of(f"wmr2_{cand}")
+        if d is regions.daemon_at(1):
+            key = cand
+            break
+    assert key is not None
+    own = regions.instance_at(1)
+    pfb0 = lane(own, "peer_pb2_fallback")
+    pw0 = lane(own, "peer_wire")
+    rs = check_wire(inst, [mr_req(key, hits=7, name="wmr2")])
+    assert rs[0].error == "" and int(rs[0].remaining) == 93
+    assert lane(own, "peer_wire") > pw0
+    assert lane(own, "peer_pb2_fallback") == pfb0
+    deadline = time.time() + 6
+    while (time.time() < deadline
+           and _west_remaining(regions, key, "wmr2") != 93):
+        time.sleep(0.05)
+    assert _west_remaining(regions, key, "wmr2") == 93
+
+
+def test_mr_wire_no_ping_pong(regions):
+    """Replicated copies strip MULTI_REGION; counters stay put after
+    convergence even with every hop on the columnar lanes."""
+    key = "wc:1"
+    inst = regions.instance_at(1)
+    check_wire(inst, [mr_req(key, hits=5, name="wmr3")])
+    deadline = time.time() + 6
+    while (time.time() < deadline
+           and _west_remaining(regions, key, "wmr3") != 95):
+        time.sleep(0.05)
+    assert _west_remaining(regions, key, "wmr3") == 95
+    time.sleep(0.5)
+    assert _west_remaining(regions, key, "wmr3") == 95
+    [r] = check_wire(inst, [mr_req(key, hits=0, name="wmr3")])
+    assert int(r.remaining) == 95
+
+
+def test_mixed_mr_and_plain_batch(regions):
+    """MR rows and plain rows in one wire batch: both served, only MR
+    replicated."""
+    inst = regions.instance_at(0)
+    reqs = [mr_req("wd:m", hits=4, name="wmr4"),
+            RateLimitRequest(name="wmr4", unique_key="wd:p", hits=1,
+                             limit=9, duration=DAY)]
+    rs = check_wire(inst, reqs)
+    assert rs[0].error == "" and int(rs[0].remaining) == 96
+    assert rs[1].error == "" and int(rs[1].remaining) == 8
+    deadline = time.time() + 6
+    while (time.time() < deadline
+           and _west_remaining(regions, "wd:m", "wmr4") != 96):
+        time.sleep(0.05)
+    assert _west_remaining(regions, "wd:m", "wmr4") == 96
+    # the plain key must NOT have replicated west: its first hit there
+    # starts from a fresh bucket (9 - 1), not from east's drained one
+    [r2] = check_wire(regions.instance_at(2),
+                      [RateLimitRequest(name="wmr4", unique_key="wd:p",
+                                        hits=1, limit=9, duration=DAY)])
+    assert int(r2.remaining) == 8

@@ -180,7 +180,11 @@ def main():
     # a measured err fraction gates the duel: a rate that isn't
     # serving the whole working set must not win the headline.
     dps_pallas, pallas_err_frac = 0.0, None
+    #: the kernel's bucketized table gets 2× the XLA CAP (one sizing
+    #: policy — the reporting fields below must reference THIS variable)
+    pallas_rows = min(CAP * 2, 1 << 26)
     if backend != "cpu" and not os.environ.get("GUBER_BENCH_NO_PALLAS"):
+        st_p = st_p2 = sample = None
         try:
             from gubernator_tpu.ops.pallas_step import (
                 decide_batch_pallas, init_pallas_table)
@@ -188,9 +192,8 @@ def main():
             dps_pallas, st_p = measure_mode(
                 decide_batch_pallas, "pallas",
                 sustain_target=4_000_000,
-                init_fn=lambda cap: init_pallas_table(
-                    min(cap * 2, 1 << 26)))
-            _, sample = decide_batch_pallas(
+                init_fn=lambda cap: init_pallas_table(pallas_rows))
+            st_p2, sample = decide_batch_pallas(
                 st_p, make_batch(key_batches[0]),
                 jnp.asarray(NOW0 + 10_000, i64))
             pallas_err_frac = round(
@@ -199,6 +202,11 @@ def main():
                 f"{pallas_err_frac}")
         except Exception as e:  # noqa: BLE001
             log(f"pallas-step mode failed: {e!r:.300}")
+        finally:
+            # drop the kernel's device buffers NOW, on every path (the
+            # ~GB bucket table + outputs): the pre-child-section client
+            # release below can only free what nothing references
+            del st_p, st_p2, sample
     rates = {"copy": dps_copy, "donate": dps_donate,
              "pallas": dps_pallas}
     eligible = dict(rates)
@@ -236,6 +244,12 @@ def main():
             "donate_mode_decisions_per_s": round(dps_donate),
             "pallas_mode_decisions_per_s": round(dps_pallas),
             "pallas_err_fraction": pallas_err_frac,
+            # the kernel owns its table layout: bucketized AoS rows,
+            # sized independently of the XLA CAP in `config` — the
+            # headline must not be attributed to a table it didn't use
+            "pallas_table_rows": (pallas_rows
+                                  if pallas_err_frac is not None
+                                  else None),
             "device_batch": B,
             "backend": backend,
             "config": f"TOKEN_BUCKET {N_KEYS} keys Zipf({ZIPF_A}) hits=1 CAP={CAP}",
@@ -244,6 +258,10 @@ def main():
             "baseline_configs": {},
         },
     }
+    if step_mode == "pallas":
+        result["extra"]["config"] += (
+            f" (headline mode pallas: bucketized table "
+            f"{pallas_rows} rows, not CAP)")
     _write_partial(result)
 
     # link round-trip floor: a trivial op's dispatch→sync time.  On a

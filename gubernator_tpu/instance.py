@@ -44,6 +44,10 @@ from .types import (
 
 log = logging.getLogger("gubernator_tpu.instance")
 
+#: empty boolean mask — the "no rows match" result when a behavior_or
+#: gate proves a column scan unnecessary (.any() is False)
+_NO_ROWS = np.zeros(0, bool)
+
 try:  # C++ wire-ingest lane (ops/_native.cpp); optional
     from .ops import native as _wire_native
 except ImportError:  # pragma: no cover - unbuilt extension
@@ -412,10 +416,15 @@ class V1Instance:
                 # cross-region asynchronously; GLOBAL takes precedence
                 # (the object path never MR-queues a GLOBAL row).
                 # Solo: every row is local.  (The clustered lane
-                # derives its own owned-rows mask.)
-                mr_mask = ((parsed["behavior"]
-                            & int(Behavior.MULTI_REGION)) != 0) & \
-                    ((parsed["behavior"] & int(Behavior.GLOBAL)) == 0)
+                # derives its own owned-rows mask.)  behavior_or gates
+                # the column scans: MR-free traffic pays nothing.
+                if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
+                    mr_mask = ((parsed["behavior"]
+                                & int(Behavior.MULTI_REGION)) != 0) & \
+                        ((parsed["behavior"]
+                          & int(Behavior.GLOBAL)) == 0)
+                else:
+                    mr_mask = _NO_ROWS
                 if is_global:
                     lane = "wire_hotset"
                     inner = self._wire_global_runner(parsed, now)
@@ -506,15 +515,17 @@ class V1Instance:
         self.metrics.wire_lane_counter.labels(lane="peer_wire").inc(
             parsed["n"])
         out = self._wire_check_columns(parsed, now)
-        beh = parsed["behavior"]
-        glob = (beh & int(Behavior.GLOBAL)) != 0
-        if glob.any():
+        # behavior_or gates the column scans: plain forwarded traffic
+        # pays nothing here
+        if parsed["behavior_or"] & int(Behavior.GLOBAL):
+            glob = (parsed["behavior"] & int(Behavior.GLOBAL)) != 0
             self._queue_global_updates_raw(parsed, data, glob)
         # NO GLOBAL precedence here: the object path's peer handler
         # queues BOTH for a GLOBAL|MULTI_REGION row (two independent
         # per-request ifs), unlike the client path
-        mr = (beh & int(Behavior.MULTI_REGION)) != 0
-        if mr.any():
+        if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
+            mr = (parsed["behavior"]
+                  & int(Behavior.MULTI_REGION)) != 0
             self._queue_mr_raw(parsed, data, mr)
         return out
 
@@ -531,7 +542,11 @@ class V1Instance:
         w = np.maximum(parsed["hits"][idx], 0)
         uniq, inv = np.unique(parsed["khash_raw"][idx],
                               return_inverse=True)
-        acc = np.bincount(inv, weights=w).astype(np.int64)
+        # exact int64 accumulation (bincount's float64 weights would
+        # round sums past 2^53 — the object-path producers are exact
+        # Python ints, and conservation must match across lanes)
+        acc = np.zeros(uniq.size, np.int64)
+        np.add.at(acc, inv, w)
         last = np.zeros(uniq.size, np.int64)
         last[inv] = np.arange(inv.size)
         for k, f, a in zip(uniq, last, acc):
@@ -751,7 +766,12 @@ class V1Instance:
 
         self_pi = [pi for pi, p in enumerate(peer_list) if self.is_self(p)]
         local_mask = np.isin(owners, self_pi)
-        glob_mask = (parsed["behavior"] & int(Behavior.GLOBAL)) != 0
+        # behavior_or gates the column scan: GLOBAL-free batches (the
+        # common clustered shape) pay nothing here
+        if parsed["behavior_or"] & int(Behavior.GLOBAL):
+            glob_mask = (parsed["behavior"] & int(Behavior.GLOBAL)) != 0
+        else:
+            glob_mask = _NO_ROWS
         glob_queue: List[tuple] = []
         if glob_mask.any():
             # every GLOBAL row is served locally; collect the reconcile
@@ -813,12 +833,15 @@ class V1Instance:
                     gm.queue_hits_raw(k, tlv, a)
         # locally-OWNED MULTI_REGION rows replicate cross-region async
         # (forwarded MR rows are queued by their owner; GLOBAL rows
-        # never MR-queue — object-path precedence)
-        mr_mask = (np.isin(owners, self_pi) & (~glob_mask)
-                   & ((parsed["behavior"]
-                       & int(Behavior.MULTI_REGION)) != 0))
-        if mr_mask.any():
-            self._queue_mr_raw(parsed, data, mr_mask)
+        # never MR-queue — object-path precedence).  behavior_or-gated
+        # like the GLOBAL scan above.
+        if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
+            not_glob = ~glob_mask if glob_mask.size else True
+            mr_mask = (np.isin(owners, self_pi) & not_glob
+                       & ((parsed["behavior"]
+                           & int(Behavior.MULTI_REGION)) != 0))
+            if mr_mask.any():
+                self._queue_mr_raw(parsed, data, mr_mask)
 
         for idxs, fut, send_err in groups:
             rbytes, err, sp = None, send_err, None

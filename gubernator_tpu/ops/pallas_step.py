@@ -161,13 +161,17 @@ def pallas_qualifies(batch: RequestBatch) -> bool:
     if batch.now is not None:
         now = np.asarray(batch.now)
         if now.size and not (now == now.flat[0]).all():
-            # stable key sort preserves batch order within a key, so
-            # per-key monotonicity = non-decreasing now on same-key
-            # neighbors in that order
-            order = np.argsort(np.asarray(batch.key), kind="stable")
-            k_s, n_s, v_s = (np.asarray(batch.key)[order], now[order],
-                             v[order])
-            same = (k_s[1:] == k_s[:-1]) & v_s[1:] & v_s[:-1]
+            # drop invalid rows FIRST: an invalid row sitting between
+            # two valid same-key rows would break the adjacency check
+            # (both pairs span an invalid member), letting a
+            # time-inverted duplicate through.  Then a stable key sort
+            # preserves batch order within a key, so per-key
+            # monotonicity = non-decreasing now on same-key neighbors.
+            keys = np.asarray(batch.key)[v]
+            now_v = now[v]
+            order = np.argsort(keys, kind="stable")
+            k_s, n_s = keys[order], now_v[order]
+            same = k_s[1:] == k_s[:-1]
             if (same & (n_s[1:] < n_s[:-1])).any():
                 return False
     return True

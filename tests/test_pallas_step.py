@@ -317,3 +317,12 @@ class TestQualifier:
         assert pallas_qualifies(
             mk_batch(keyify(np.array([1, 1, 2])),
                      now=jnp.asarray(nows_ok, i64)))
+        # an INVALID row between two time-inverted valid duplicates
+        # must not mask the inversion (adjacency check runs on valid
+        # rows only)
+        keys3 = keyify(np.array([1, 1, 1]))
+        nows3 = np.array([NOW + 100, NOW, NOW + 50], np.int64)
+        valid3 = np.array([True, False, True])
+        assert not pallas_qualifies(
+            mk_batch(keys3, now=jnp.asarray(nows3, i64),
+                     valid=jnp.asarray(valid3)))

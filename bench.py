@@ -117,6 +117,18 @@ def main():
     def make_batch(keys):
         return RequestBatch(key=keys, **const)
 
+    # Hot-loop time source: ONE host→device transfer, then a jitted
+    # device-side bump per step.  A per-rep `jnp.asarray(now0 + r)` is a
+    # synchronous host→device round trip on the tunneled backend — on a
+    # degraded link (observed 2026-08-01: ~26-216 ms per transfer while
+    # dispatch stayed fully async at 0.02 ms) it serializes the whole
+    # sustained loop and the "rate" becomes a link measurement.  The
+    # device bump keeps the loop transfer-free with identical time
+    # semantics (now advances by 1 per step).
+    _bump1 = _bump_fn()
+    _bump1(jnp.asarray(0, i64)).block_until_ready()  # compile now, not
+    # inside any timed region below
+
     def populate(step_fn, st):
         """Insert ALL N_KEYS distinct keys so the measured loop runs at
         the claimed working set (load factor N_KEYS/CAP), not at the few
@@ -124,10 +136,11 @@ def main():
         the sustained number must be the steady-state resident-table
         rate it claims to be."""
         ids = np.arange(N_KEYS, dtype=np.uint64)
+        now_pop = jnp.asarray(NOW0, i64)
         for a in range(0, N_KEYS, B):
             chunk = pad_chunk(ids[a:a + B], B)
             st, out = step_fn(st, make_batch(jnp.asarray(_keyhash(chunk))),
-                              jnp.asarray(NOW0, i64))
+                              now_pop)
         out.status.block_until_ready()
         return st
 
@@ -146,15 +159,18 @@ def main():
         st = populate(step_fn, st)
         log(f"[{label}] populated {N_KEYS} keys "
             f"(load {N_KEYS/CAP:.2f}) in {time.perf_counter() - t0:.1f}s")
+        now_dev = jnp.asarray(NOW0, i64)
         for i in range(1, n_batches):
-            st, out = step_fn(st, make_batch(key_batches[i]),
-                              jnp.asarray(NOW0 + i, i64))
+            now_dev = _bump1(now_dev)
+            st, out = step_fn(st, make_batch(key_batches[i]), now_dev)
         out.status.block_until_ready()
         reps = max(1, int(sustain_target / B / n_batches)) * n_batches
+        now_dev = jnp.asarray(NOW0 + 100, i64)
         t0 = time.perf_counter()
         for r in range(reps):
             st, out = step_fn(st, make_batch(key_batches[r % n_batches]),
-                              jnp.asarray(NOW0 + 100 + r, i64))
+                              now_dev)
+            now_dev = _bump1(now_dev)
         out.status.block_until_ready()
         dt = time.perf_counter() - t0
         rate = reps * B / dt
@@ -434,14 +450,39 @@ def _write_partial(result: dict) -> None:
         log(f"partial checkpoint write failed: {e}")
 
 
+_BUMP_CACHE: dict = {}
+
+
+def _bump_fn(delta=1):
+    """Shared jitted device-side `now += delta` (one compile per delta
+    per process — jit caches per function object, so per-call lambdas
+    would re-trace every time)."""
+    f = _BUMP_CACHE.get(delta)
+    if f is None:
+        import jax
+
+        f = jax.jit(lambda t: t + delta)
+        _BUMP_CACHE[delta] = f
+    return f
+
+
 def _sustain(decide_batch, jnp, state, batches, reps, now0):
-    """Measure a sustained dispatch loop → decisions/s."""
+    """Measure a sustained dispatch loop → decisions/s.  The advancing
+    `now` lives on device (one transfer + a jitted bump per rep): per-rep
+    host→device transfers are synchronous on the tunneled backend and
+    would turn the loop into a link-RTT measurement."""
     i64 = jnp.int64
+    bump = _bump_fn()
+    # warm the bump OUTSIDE the timed region (its first call is a
+    # synchronous remote compile over the tunnel): now0-1 → now0
+    now_dev = bump(jnp.asarray(now0 - 1, i64))
+    now_dev.block_until_ready()
     out = None
     t0 = time.perf_counter()
     for r in range(reps):
         state, out = decide_batch(state, batches[r % len(batches)],
-                                  jnp.asarray(now0 + r, i64))
+                                  now_dev)
+        now_dev = bump(now_dev)
     out.status.block_until_ready()
     dt = time.perf_counter() - t0
     return reps * batches[0].key.shape[0] / dt, state
@@ -580,10 +621,15 @@ def _sec_scan():
     st_s, ov = decide_scan(st_s, keys_rb, jnp.asarray(NOW0, i64))
     ov.block_until_ready()  # compile + warm
     reps_s = max(1, int(30_000_000 / (R * B)))
+    bump_R = _bump_fn(R)  # device-side now advance: the inter-launch
+    # `jnp.asarray` transfer is synchronous over the tunnel
+    # warm outside the timed region: NOW0+1000-R → NOW0+1000
+    now_dev = bump_R(jnp.asarray(NOW0 + 1000 - R, i64))
+    now_dev.block_until_ready()
     t0 = time.perf_counter()
     for r in range(reps_s):
-        st_s, ov = decide_scan(st_s, keys_rb,
-                               jnp.asarray(NOW0 + 1000 + r * R, i64))
+        st_s, ov = decide_scan(st_s, keys_rb, now_dev)
+        now_dev = bump_R(now_dev)
     ov.block_until_ready()
     dps_scan = reps_s * R * B / (time.perf_counter() - t0)
     return {"device_scan_decisions_per_s": round(dps_scan),
@@ -651,10 +697,14 @@ def _sec_cfg4():
     sh = NamedSharding(mesh, P("shard"))
     bg = RequestBatch(*[jax.device_put(np.asarray(x), sh) for x in bg])
     stg, o, _ = step(stg, bg, jnp.asarray(NOW0, i64))
+    bump = _bump_fn()  # transfer-free now advance, warmed pre-timing
+    now_dev = bump(jnp.asarray(NOW0, i64))  # NOW0 → NOW0+1
+    now_dev.block_until_ready()
     t0 = time.perf_counter()
     reps = 20
     for r in range(reps):
-        stg, o, _ = step(stg, bg, jnp.asarray(NOW0 + 1 + r, i64))
+        stg, o, _ = step(stg, bg, now_dev)
+        now_dev = bump(now_dev)
     o[0].block_until_ready()
     dps4 = reps * Bg / (time.perf_counter() - t0)
     row = {"decisions_per_s": round(dps4), "n_shards": int(n)}

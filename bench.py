@@ -1410,7 +1410,17 @@ def _watchdog_main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("GUBER_BENCH_SECTION"):
+    # operator hold-off: lets a supervising session stop an already-
+    # launched benchmark (or its watchdog/section children — each one
+    # re-enters here) from starting device work.  The battery spawns
+    # bench.py as a child long after launch; killing that child mid-
+    # compile is the known tunnel-wedge mechanism, a sentinel is safe.
+    if os.path.exists("/tmp/GUBER_BENCH_SKIP"):
+        print(json.dumps({"metric": "skipped", "value": 0, "unit": "",
+                          "vs_baseline": 0.0,
+                          "extra": {"skipped":
+                                    "/tmp/GUBER_BENCH_SKIP present"}}))
+    elif os.environ.get("GUBER_BENCH_SECTION"):
         _section_main()
     elif os.environ.get("GUBER_BENCH_INNER"):
         main()

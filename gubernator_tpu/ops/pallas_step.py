@@ -111,6 +111,24 @@ def _sel(c, a, b):
     return jnp.where(c, a, b)
 
 
+def _tsum8(v):
+    """(8,) i32 → scalar sum via an explicit halving tree.  jnp.sum on
+    a rank-1 vector goes through Mosaic's proxy lowering, which
+    re-traces under the ambient x64 config and emits 64-bit converts
+    that have no TPU lowering (observed on-chip 2026-08-01); elementwise
+    adds + a final scalar extract lower natively."""
+    m = v[:4] + v[4:]
+    m = m[:2] + m[2:]
+    return m[0] + m[1]
+
+
+def _tmin8(v):
+    """(8,) i32 → scalar min via a halving tree (see _tsum8)."""
+    m = jnp.minimum(v[:4], v[4:])
+    m = jnp.minimum(m[:2], m[2:])
+    return jnp.minimum(m[0], m[1])
+
+
 def _sel64(c, ah, al, bh, bl):
     return jnp.where(c, ah, bh), jnp.where(c, al, bl)
 
@@ -191,14 +209,14 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
     i32 = jnp.int32
 
     def first_live(j):
-        return (brep_ref[0, j] == j) & (valid_ref[0, j] != 0)
+        return (brep_ref[0, 0, j] == j) & (valid_ref[0, 0, j] != 0)
 
     # 1) gather: one DMA per distinct live bucket in the tile
     def issue_in(j, c):
         @pl.when(first_live(j))
         def _():
             pltpu.make_async_copy(
-                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                table_ref.at[pl.ds(bb_ref[0, 0, j], SLOTS)],
                 scratch.at[pl.ds(j * SLOTS, SLOTS)],
                 sem_in.at[j]).start()
         return c
@@ -209,7 +227,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
         @pl.when(first_live(j))
         def _():
             pltpu.make_async_copy(
-                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                table_ref.at[pl.ds(bb_ref[0, 0, j], SLOTS)],
                 scratch.at[pl.ds(j * SLOTS, SLOTS)],
                 sem_in.at[j]).wait()
         return c
@@ -217,29 +235,32 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
     lax.fori_loop(0, TILE, wait_in, 0)
 
     lane = lax.broadcasted_iota(i32, (SLOTS, WORDS), 1)
-    srow = lax.broadcasted_iota(i32, (SLOTS,), 0)
 
     # 2) apply requests in order against the live bucket copies
     def body(j, c):
-        valid = valid_ref[0, j] != 0
+        valid = valid_ref[0, 0, j] != 0
 
         @pl.when(valid)
         def _process():
-            base = brep_ref[0, j] * SLOTS
+            base = brep_ref[0, 0, j] * SLOTS
             tile = scratch[pl.ds(base, SLOTS), :]  # [SLOTS, WORDS]
-            klo, khi = klo_ref[0, j], khi_ref[0, j]
+            klo, khi = klo_ref[0, 0, j], khi_ref[0, 0, j]
 
             def col(w):
                 return tile[:, w]
 
             match = (col(W_KLO) == klo) & (col(W_KHI) == khi)
-            found = jnp.any(match)
+            # all reductions in i32: Mosaic's bool reduce_or/any proxy
+            # lowers through float64, which has no scalar conversion
+            # on TPU (observed on-chip 2026-08-01)
+            found = _tsum8(match.astype(i32)) > 0
             empty = (col(W_KLO) == 0) & (col(W_KHI) == 0)
-            # first empty slot (cumsum trick: stable, deterministic)
-            first_empty = empty & (jnp.cumsum(
-                empty.astype(jnp.float32)) < 1.5) & (jnp.cumsum(
-                    empty.astype(jnp.float32)) > 0.5)
-            has_empty = jnp.any(empty)
+            # first empty slot: lowest slot index among empties (iota +
+            # min — stable, deterministic, no float cumsum)
+            slot_iota = lax.broadcasted_iota(i32, (SLOTS,), 0)
+            first_idx = _tmin8(jnp.where(empty, slot_iota, i32(SLOTS)))
+            first_empty = empty & (slot_iota == first_idx)
+            has_empty = first_idx < i32(SLOTS)
             insert = (~found) & has_empty
             err = (~found) & (~has_empty)  # bucket full
             slot1h = jnp.where(found, match, first_empty)  # [SLOTS]
@@ -247,7 +268,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
             def pick(w):
                 """matched/claimed slot's word w as a scalar (0 for a
                 fresh insert: empty slots hold zero words)."""
-                return jnp.sum(jnp.where(slot1h, col(w), i32(0)))
+                return _tsum8(jnp.where(slot1h, col(w), i32(0)))
 
             # item state (insert reads the zeroed empty slot → fresh
             # fires below, matching the XLA path's post-insert read)
@@ -259,17 +280,17 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
             it_dlo, it_dhi = pick(W_DLO), pick(W_DHI)
 
             # request fields
-            r_hits, r_lim = hits_ref[0, j], lim_ref[0, j]
-            r_dlo, r_dhi = dlo_ref[0, j], dhi_ref[0, j]
-            r_elo, r_ehi = elo_ref[0, j], ehi_ref[0, j]
-            r_glo, r_ghi = glo_ref[0, j], ghi_ref[0, j]
-            beh = beh_ref[0, j]
+            r_hits, r_lim = hits_ref[0, 0, j], lim_ref[0, 0, j]
+            r_dlo, r_dhi = dlo_ref[0, 0, j], dhi_ref[0, 0, j]
+            r_elo, r_ehi = elo_ref[0, 0, j], ehi_ref[0, 0, j]
+            r_glo, r_ghi = glo_ref[0, 0, j], ghi_ref[0, 0, j]
+            beh = beh_ref[0, 0, j]
             is_greg = (beh & _GREG) != 0
             reset = (beh & _RESET) != 0
             drain = (beh & _DRAIN) != 0
 
             # now = max(req.now, item.t)  (per-key monotonic clock)
-            nhi0, nlo0 = nhi_ref[0, j], nlo_ref[0, j]
+            nhi0, nlo0 = nhi_ref[0, 0, j], nlo_ref[0, 0, j]
             use_req = _ge64(nhi0, nlo0, it_thi, it_tlo)
             nhi1, nlo1 = _sel64(use_req, nhi0, nlo0, it_thi, it_tlo)
 
@@ -343,22 +364,22 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
 
             # outputs (err rows zeroed, as the XLA step masks them)
             dead = err
-            st_o[0, j] = _sel(dead, i32(0), status1)
-            rem_o[0, j] = _sel(dead, i32(0), rem2)
-            rlo_o[0, j] = _sel(dead, i32(0), x_lo)
-            rhi_o[0, j] = _sel(dead, i32(0), x_hi)
-            lim_o[0, j] = _sel(dead, i32(0), r_lim)
-            flg_o[0, j] = err.astype(i32) | (
+            st_o[0, 0, j] = _sel(dead, i32(0), status1)
+            rem_o[0, 0, j] = _sel(dead, i32(0), rem2)
+            rlo_o[0, 0, j] = _sel(dead, i32(0), x_lo)
+            rhi_o[0, 0, j] = _sel(dead, i32(0), x_hi)
+            lim_o[0, 0, j] = _sel(dead, i32(0), r_lim)
+            flg_o[0, 0, j] = err.astype(i32) | (
                 (insert & ~err).astype(i32) << 1)
 
         @pl.when(~valid)
         def _invalid():
-            st_o[0, j] = i32(0)
-            rem_o[0, j] = i32(0)
-            rlo_o[0, j] = i32(0)
-            rhi_o[0, j] = i32(0)
-            lim_o[0, j] = i32(0)
-            flg_o[0, j] = i32(0)
+            st_o[0, 0, j] = i32(0)
+            rem_o[0, 0, j] = i32(0)
+            rlo_o[0, 0, j] = i32(0)
+            rhi_o[0, 0, j] = i32(0)
+            lim_o[0, 0, j] = i32(0)
+            flg_o[0, 0, j] = i32(0)
 
         return c
 
@@ -371,7 +392,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
         def _():
             pltpu.make_async_copy(
                 scratch.at[pl.ds(j * SLOTS, SLOTS)],
-                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                table_ref.at[pl.ds(bb_ref[0, 0, j], SLOTS)],
                 sem_out.at[j]).start()
         return c
 
@@ -382,7 +403,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
         def _():
             pltpu.make_async_copy(
                 scratch.at[pl.ds(j * SLOTS, SLOTS)],
-                table_ref.at[pl.ds(bb_ref[0, j], SLOTS)],
+                table_ref.at[pl.ds(bb_ref[0, 0, j], SLOTS)],
                 sem_out.at[j]).wait()
         return c
 
@@ -390,14 +411,20 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
 
 
 def _call_kernel(rows, cols, interpret: bool):
-    """cols: 16 int32 arrays shaped [G, TILE] (see _kernel order)."""
+    """cols: 16 int32 arrays shaped [G, 1, TILE] (see _kernel order).
+
+    The singleton middle axis is load-bearing on real Mosaic: a block's
+    last two dims must be divisible by (8, 128) or equal the array's —
+    a [G, TILE] array with (1, TILE) blocks violates that (observed
+    on-chip 2026-08-01), while [G, 1, TILE] with (1, 1, TILE) blocks
+    has last-two dims (1, TILE) == the array's, which is allowed."""
     G = cols[0].shape[0]
-    smem_tile = pl.BlockSpec((1, TILE), lambda i: (i, 0),
+    smem_tile = pl.BlockSpec((1, 1, TILE), lambda i: (i, 0, 0),
                              memory_space=pltpu.SMEM)
-    out_tile = pl.BlockSpec((1, TILE), lambda i: (i, 0),
+    out_tile = pl.BlockSpec((1, 1, TILE), lambda i: (i, 0, 0),
                             memory_space=pltpu.SMEM)
     table_spec = pl.BlockSpec(memory_space=pl.ANY)
-    o32 = jax.ShapeDtypeStruct((G, TILE), jnp.int32)
+    o32 = jax.ShapeDtypeStruct((G, 1, TILE), jnp.int32)
     with jax.enable_x64(False):
         return pl.pallas_call(
             _kernel,
@@ -477,7 +504,9 @@ def decide_batch_pallas(table: PallasTable, batch: RequestBatch, now_ms,
     eq = rep_key[:, :, None] == rep_key[:, None, :]
     brep = jnp.argmax(eq, axis=-1).astype(i32)  # first True per row
 
-    cols = [bt, brep] + [c.reshape(G, TILE) for c in cols1d[1:]]
+    # [G, 1, TILE]: the singleton axis satisfies Mosaic's block-shape
+    # rule (see _call_kernel)
+    cols = [c.reshape(G, 1, TILE) for c in [bt, brep] + cols1d[1:]]
     rows2, st, rem, rlo, rhi, lim, flg = _call_kernel(
         table.rows, cols, interpret)
 

@@ -141,8 +141,17 @@ def _insert(tkey: jax.Array, slots: jax.Array, key: jax.Array,
         c_s = cand_eff[order]
         first = jnp.concatenate([jnp.ones(1, bool), c_s[1:] != c_s[:-1]])
         first = first & (c_s < cap)
-        winner = jnp.zeros(B, bool).at[order].set(first)
-        tkey = tkey.at[jnp.where(winner, cand, cap)].set(key, mode="drop")
+        # order is a permutation and winning cands are slot-deduped, so
+        # both scatters can promise uniqueness (losers get DISTINCT
+        # out-of-bounds sentinels, dropped by mode="drop") — without the
+        # promise the TPU backend must assume colliding writes and can
+        # emit a serialized scatter loop (observed 2026-08-01: 217 ms
+        # per step at CAP >= 2^22 vs 0.118 ms at 2^21)
+        winner = jnp.zeros(B, bool).at[order].set(first,
+                                                  unique_indices=True)
+        tkey = tkey.at[
+            jnp.where(winner, cand, cap + jnp.arange(B, dtype=cand.dtype))
+        ].set(key, mode="drop", unique_indices=True)
         row = jnp.where(winner, cand, row)
         n_claimed = n_claimed + winner.sum(dtype=jnp.int64)
 
@@ -427,11 +436,17 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
                           t_rem // jnp.maximum(item1.eff[sid], 1), t_rem)
     tail_mask = simple[sid] & (pos > 0)
 
-    # assemble sorted-order outputs: heads then simple tails
-    o_status = jnp.zeros(B, i32).at[idx0].set(out0[0], mode="drop")
-    o_rem = jnp.zeros(B, i64).at[idx0].set(out0[1], mode="drop")
-    o_reset = jnp.zeros(B, i64).at[idx0].set(out0[2], mode="drop")
-    o_limit = jnp.zeros(B, i64).at[idx0].set(out0[3], mode="drop")
+    # assemble sorted-order outputs: heads then simple tails.  out0 is
+    # per-SEGMENT-ID; a segment's head value lands on its head lane.
+    # The historical `zeros.at[idx0].set(out0)` scatter (idx0 =
+    # seg_start per segment id) is equivalent to a head-masked gather
+    # by seg_id — a select + contiguous gather lowers cheaply on every
+    # backend, where scatter is the op the TPU backend can serialize.
+    head_w = head & exists[sid]
+    o_status = jnp.where(head_w, out0[0][sid], 0).astype(i32)
+    o_rem = jnp.where(head_w, out0[1][sid], 0)
+    o_reset = jnp.where(head_w, out0[2][sid], 0)
+    o_limit = jnp.where(head_w, out0[3][sid], 0)
     o_status = jnp.where(tail_mask, t_status, o_status)
     o_rem = jnp.where(tail_mask, t_rem_out, o_rem)
     o_reset = jnp.where(tail_mask, out0[2][sid], o_reset)
@@ -548,15 +563,20 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     def body_fn(c):
         j, item, (os_, or_, ot_, ol_) = c
-        idxj = jnp.where(complex_seg & (j < seg_len), seg_start + j, B).astype(i32)
-        reqj = _Req(*[x.at[idxj].get(mode="fill", fill_value=0) for x in sf])
         m = complex_seg & (j < seg_len)
+        # active indices seg_start+j are distinct across segments and
+        # inactive lanes get DISTINCT OOB sentinels (dropped), so the
+        # unique promise holds — same backend-vectorization rationale
+        # as the table writeback below
+        idxj = jnp.where(m, seg_start + j,
+                         B + jnp.arange(B, dtype=i32)).astype(i32)
+        reqj = _Req(*[x.at[idxj].get(mode="fill", fill_value=0) for x in sf])
         item2, outj = _apply_position(item, reqj)
         item = _tree_where(m, item2, item)
-        os_ = os_.at[idxj].set(outj[0], mode="drop")
-        or_ = or_.at[idxj].set(outj[1], mode="drop")
-        ot_ = ot_.at[idxj].set(outj[2], mode="drop")
-        ol_ = ol_.at[idxj].set(outj[3], mode="drop")
+        os_ = os_.at[idxj].set(outj[0], mode="drop", unique_indices=True)
+        or_ = or_.at[idxj].set(outj[1], mode="drop", unique_indices=True)
+        ot_ = ot_.at[idxj].set(outj[2], mode="drop", unique_indices=True)
+        ol_ = ol_.at[idxj].set(outj[3], mode="drop", unique_indices=True)
         return j + 1, item, (os_, or_, ot_, ol_)
 
     _, item_final, (o_status, o_rem, o_reset, o_limit) = lax.while_loop(
@@ -565,7 +585,14 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     )
 
     # ---- write back per-segment final state ----------------------------
-    wrow = jnp.where(exists, seg_row, cap)
+    # wrow is per SEGMENT ID — one writer per segment already (sorted
+    # by row, so live segments have distinct rows).  The non-existent
+    # segments get DISTINCT out-of-bounds sentinels (dropped by
+    # mode="drop") so the unique_indices promise below is honest: it
+    # lets the TPU backend vectorize the scatters instead of assuming
+    # colliding writes (the CAP>=2^22 217 ms/step serialization,
+    # 2026-08-01)
+    wrow = jnp.where(exists, seg_row, cap + jnp.arange(B, dtype=i32))
     meta_new = (item_final.alg & 1) | ((item_final.status & 1) << 1)
 
     # Hot/cold column split (PERF.md §4.1, VERDICT r1 item 2): the four
@@ -584,10 +611,14 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     def _cold_scatter(cols):
         limit_c, duration_c, eff_c, burst_c = cols
-        return (limit_c.at[wrow].set(item_final.limit, mode="drop"),
-                duration_c.at[wrow].set(item_final.duration, mode="drop"),
-                eff_c.at[wrow].set(item_final.eff, mode="drop"),
-                burst_c.at[wrow].set(item_final.burst, mode="drop"))
+        return (limit_c.at[wrow].set(item_final.limit, mode="drop",
+                                     unique_indices=True),
+                duration_c.at[wrow].set(item_final.duration, mode="drop",
+                                        unique_indices=True),
+                eff_c.at[wrow].set(item_final.eff, mode="drop",
+                                   unique_indices=True),
+                burst_c.at[wrow].set(item_final.burst, mode="drop",
+                                     unique_indices=True))
 
     limit_n, duration_n, eff_n, burst_n = lax.cond(
         cold_dirty, _cold_scatter, lambda cols: cols,
@@ -595,18 +626,23 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     new_state = TableState(
         key=tkey,
-        meta=state.meta.at[wrow].set(meta_new.astype(i32), mode="drop"),
+        meta=state.meta.at[wrow].set(meta_new.astype(i32), mode="drop",
+                                     unique_indices=True),
         limit=limit_n,
         duration=duration_n,
         eff_ms=eff_n,
         burst=burst_n,
-        remaining=state.remaining.at[wrow].set(item_final.rem, mode="drop"),
-        t_ms=state.t_ms.at[wrow].set(item_final.t, mode="drop"),
-        expire_at=state.expire_at.at[wrow].set(item_final.exp, mode="drop"),
+        remaining=state.remaining.at[wrow].set(item_final.rem, mode="drop",
+                                               unique_indices=True),
+        t_ms=state.t_ms.at[wrow].set(item_final.t, mode="drop",
+                                     unique_indices=True),
+        expire_at=state.expire_at.at[wrow].set(item_final.exp, mode="drop",
+                                               unique_indices=True),
     )
 
     # ---- back to request order -----------------------------------------
-    inv = jnp.zeros(B, i32).at[perm].set(jnp.arange(B, dtype=i32))
+    inv = jnp.zeros(B, i32).at[perm].set(jnp.arange(B, dtype=i32),
+                                         unique_indices=True)
     status = jnp.where(valid & (~err), o_status[inv], 0)
     remaining = jnp.where(valid & (~err), o_rem[inv], 0)
     reset_time = jnp.where(valid & (~err), o_reset[inv], 0)

@@ -1,0 +1,67 @@
+"""Offline Mosaic lowering check — no TPU, no remote compile.
+
+Cross-platform AOT lowering (``jit(f).trace(args).lower(
+lowering_platforms=("tpu",))``) runs the full Pallas→Mosaic MLIR
+pipeline client-side on the CPU backend and surfaces every lowering
+error in seconds.  This is how the three on-chip-only kernel failures
+of 2026-08-01 (block-shape rule, rank-1 reduction proxies emitting
+64-bit converts, float cumsum) were fixed without burning flaky-tunnel
+compile windows: each on-chip attempt costs a ~220 s remote compile
+plus wedge risk, the offline check costs ~5 s.
+
+Usage: python tools/lower_check.py   (exit 0 = kernel lowers)
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # the engine's contract
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.core.step import decide_batch, decide_batch_donated
+    from gubernator_tpu.core.table import init_table
+    from gubernator_tpu.ops.pallas_step import (decide_batch_pallas,
+                                                init_pallas_table)
+
+    i64 = jnp.int64
+    n = 512
+    # uint64 like every real caller (bench._keyhash / the engines):
+    # int64 keys would promote int64>>uint64 to float64 in _probe_slots
+    keys = np.arange(1, n + 1, dtype=np.uint64) * np.uint64(2654435761)
+    b = RequestBatch(
+        key=jnp.asarray(keys), hits=jnp.ones(n, i64),
+        limit=jnp.full(n, 100, i64), duration=jnp.full(n, 10_000, i64),
+        eff_ms=jnp.full(n, 10_000, i64), greg_end=jnp.zeros(n, i64),
+        behavior=jnp.zeros(n, jnp.int32), algorithm=jnp.zeros(n, jnp.int32),
+        burst=jnp.full(n, 100, i64), valid=jnp.ones(n, bool))
+    now = jnp.asarray(1_760_000_000_000, i64)
+    failures = 0
+    for name, fn, state in (
+            ("pallas_step", decide_batch_pallas, init_pallas_table(1 << 12)),
+            ("xla_step", decide_batch, init_table(1 << 12)),
+            ("xla_step_donated", decide_batch_donated, init_table(1 << 12))):
+        try:
+            # fn is already jitted (with donate_argnums where relevant)
+            # — re-wrapping in jax.jit would drop the donation and lower
+            # a copy-mode duplicate instead of the aliased program
+            fn.trace(state, b, now).lower(lowering_platforms=("tpu",))
+            print(f"{name}: lowers for TPU")
+        except Exception as e:  # noqa: BLE001
+            failures += 1
+            print(f"{name}: LOWERING FAILED: {str(e)[:400]}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

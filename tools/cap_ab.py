@@ -1,0 +1,103 @@
+"""One-question on-chip probe: is the donated step still pathological
+at CAP >= 2^22 after the unique-indices scatter change?
+
+Cheapest possible answer (one compile + populate + 32 reps, ~5 min
+cold): run this FIRST in a live tunnel window, before tpu_session.py —
+if ms_per_step is back near the round-2 0.45 ms @ 2^22, the full
+battery's capacity sweep and bench will inherit the fix; if it still
+reads ~217 ms, the Pallas floor is the headline plan and the battery
+should still run (its duel covers all three modes).
+
+Usage: timeout 1200 python tools/cap_ab.py [log2cap]
+Writes /tmp/cap_ab.json; copy into artifacts/ and commit.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+import _jax_cache
+
+_jax_cache.setup()
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _keyhash as keyhash, pad_chunk
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.core.step import decide_batch_donated
+    from gubernator_tpu.core.table import init_table
+
+    log2cap = int(sys.argv[1]) if len(sys.argv) > 1 else 22
+    cap, n_keys = 1 << log2cap, (1 << log2cap) // 2
+    B = 65536
+    i64 = jnp.int64
+    out_path = "/tmp/cap_ab.json"
+    res = {"backend": jax.default_backend(), "cap": cap, "n_keys": n_keys,
+           "B": B, "started": time.strftime("%Y-%m-%d %H:%M:%S")}
+
+    def dump():
+        with open(out_path, "w") as f:
+            json.dump(res, f, indent=1)
+
+    dump()
+    if res["backend"] != "tpu":
+        res["abort"] = "not tpu"
+        dump()
+        return 1
+
+    rng = np.random.default_rng(42)
+
+    def mk(keys):
+        n = keys.shape[0]
+        return RequestBatch(
+            key=jnp.asarray(keys), hits=jnp.ones(n, i64),
+            limit=jnp.full(n, 100, i64), duration=jnp.full(n, 10_000, i64),
+            eff_ms=jnp.full(n, 10_000, i64), greg_end=jnp.zeros(n, i64),
+            behavior=jnp.zeros(n, jnp.int32),
+            algorithm=jnp.zeros(n, jnp.int32),
+            burst=jnp.full(n, 100, i64), valid=jnp.ones(n, bool))
+
+    NOW = 1_760_000_000_000
+    bump = jax.jit(lambda t: t + 1)
+    now0 = jnp.asarray(NOW, i64)
+    bump(now0).block_until_ready()
+
+    st = init_table(cap)
+    batches = [mk(keyhash((rng.zipf(1.1, size=B) % n_keys)
+                          .astype(np.uint64))) for _ in range(4)]
+    t = time.time()
+    st, out = decide_batch_donated(st, batches[0], now0)
+    out.status.block_until_ready()
+    res["compile_s"] = round(time.time() - t, 1)
+    dump()
+    ids = np.arange(n_keys, dtype=np.uint64)
+    for a in range(0, n_keys, B):
+        st, out = decide_batch_donated(
+            st, mk(keyhash(pad_chunk(ids[a:a + B], B))), now0)
+    out.status.block_until_ready()
+    now_dev = bump(now0)
+    reps = 32
+    t = time.time()
+    for r in range(reps):
+        st, out = decide_batch_donated(st, batches[r % 4], now_dev)
+        now_dev = bump(now_dev)
+    out.status.block_until_ready()
+    dt = time.time() - t
+    res["ms_per_step"] = round(dt / reps * 1e3, 3)
+    res["decisions_per_s"] = round(reps * B / dt)
+    res["verdict"] = ("FIXED" if dt / reps < 0.01 else
+                      "still pathological" if dt / reps > 0.05 else
+                      "improved")
+    dump()
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

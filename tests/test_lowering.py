@@ -1,0 +1,34 @@
+"""TPU-lowering regression gate, no TPU required.
+
+tools/lower_check.py cross-lowers all three decision-step modes for the
+TPU target on the CPU backend (``trace().lower(lowering_platforms=
+("tpu",))`` runs the full Pallas→Mosaic pipeline client-side).  Three
+kernel bugs that only surfaced on real hardware on 2026-08-01 — the
+Mosaic block-shape rule, rank-1 reduction proxies emitting float64
+converts under global x64, and an unsupported float cumsum — are all
+caught by this check; this test keeps them caught.
+
+Runs in a subprocess: the check needs its own interpreter (platform
+config + x64 are set at import time, and conftest's 8-device CPU setup
+must not leak in).
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_all_step_modes_lower_for_tpu():
+    # minimal env: conftest mutates XLA_FLAGS/JAX_* at import time and
+    # forwarding them would make this gate test a different config than
+    # a standalone `python tools/lower_check.py`
+    env = {k: v for k, v in os.environ.items()
+           if not (k.startswith(("JAX_", "XLA_")) or k.startswith("GUBER_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lower_check.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, f"lowering check failed:\n{r.stdout}\n{r.stderr}"
+    for name in ("pallas_step", "xla_step", "xla_step_donated"):
+        assert f"{name}: lowers for TPU" in r.stdout, r.stdout

@@ -50,6 +50,27 @@ _GREG = int(Behavior.DURATION_IS_GREGORIAN)
 
 _I64_MAX = jnp.iinfo(jnp.int64).max
 
+#: test hook (tests/test_scatter_invariants.py): when True at TRACE
+#: time, every step asserts the writeback index vector really is
+#: strictly ascending + unique — the promises the scatters below make
+#: to the backend (unique_indices / indices_are_sorted are UB if lied
+#: about, and a CPU parity run would not catch the lie).
+_CHECK_SCATTER_INVARIANTS = False
+_SCATTER_INVARIANT_VIOLATIONS: list = []
+
+
+_SCATTER_INVARIANT_CHECKS = [0]  # fire counter: a hook that never ran
+# would make the invariant test pass vacuously
+
+
+def _record_wrow(wrow_np):
+    import numpy as np
+
+    _SCATTER_INVARIANT_CHECKS[0] += 1
+    w = np.asarray(wrow_np)
+    if not (np.diff(w.astype(np.int64)) > 0).all():
+        _SCATTER_INVARIANT_VIOLATIONS.append(w.copy())
+
 
 class StepOutput(NamedTuple):
     """Per-request results in original request order."""
@@ -591,8 +612,16 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     # mode="drop") so the unique_indices promise below is honest: it
     # lets the TPU backend vectorize the scatters instead of assuming
     # colliding writes (the CAP>=2^22 217 ms/step serialization,
-    # 2026-08-01)
+    # 2026-08-01).  The vector is also globally ASCENDING — both sort
+    # paths end with a stable argsort by row, so seg_row rises across
+    # live segment ids (err/invalid rows are remapped to cap and sort
+    # LAST into a non-exists segment), and the cap+i sentinels occupy
+    # ids >= n_segments with values > any live row — hence
+    # indices_are_sorted too (verified on real wrow vectors by
+    # tests/test_scatter_invariants.py)
     wrow = jnp.where(exists, seg_row, cap + jnp.arange(B, dtype=i32))
+    if _CHECK_SCATTER_INVARIANTS:  # trace-time test hook, no cost when off
+        jax.debug.callback(_record_wrow, wrow)
     meta_new = (item_final.alg & 1) | ((item_final.status & 1) << 1)
 
     # Hot/cold column split (PERF.md §4.1, VERDICT r1 item 2): the four
@@ -612,13 +641,17 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     def _cold_scatter(cols):
         limit_c, duration_c, eff_c, burst_c = cols
         return (limit_c.at[wrow].set(item_final.limit, mode="drop",
-                                     unique_indices=True),
+                                     unique_indices=True,
+                                     indices_are_sorted=True),
                 duration_c.at[wrow].set(item_final.duration, mode="drop",
-                                        unique_indices=True),
+                                        unique_indices=True,
+                                        indices_are_sorted=True),
                 eff_c.at[wrow].set(item_final.eff, mode="drop",
-                                   unique_indices=True),
+                                   unique_indices=True,
+                                   indices_are_sorted=True),
                 burst_c.at[wrow].set(item_final.burst, mode="drop",
-                                     unique_indices=True))
+                                     unique_indices=True,
+                                     indices_are_sorted=True))
 
     limit_n, duration_n, eff_n, burst_n = lax.cond(
         cold_dirty, _cold_scatter, lambda cols: cols,
@@ -627,17 +660,21 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     new_state = TableState(
         key=tkey,
         meta=state.meta.at[wrow].set(meta_new.astype(i32), mode="drop",
-                                     unique_indices=True),
+                                     unique_indices=True,
+                                     indices_are_sorted=True),
         limit=limit_n,
         duration=duration_n,
         eff_ms=eff_n,
         burst=burst_n,
         remaining=state.remaining.at[wrow].set(item_final.rem, mode="drop",
-                                               unique_indices=True),
+                                               unique_indices=True,
+                                               indices_are_sorted=True),
         t_ms=state.t_ms.at[wrow].set(item_final.t, mode="drop",
-                                     unique_indices=True),
+                                     unique_indices=True,
+                                     indices_are_sorted=True),
         expire_at=state.expire_at.at[wrow].set(item_final.exp, mode="drop",
-                                               unique_indices=True),
+                                               unique_indices=True,
+                                               indices_are_sorted=True),
     )
 
     # ---- back to request order -----------------------------------------

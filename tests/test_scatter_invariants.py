@@ -1,0 +1,83 @@
+"""The writeback scatters' promises to the backend are verified, not
+assumed.
+
+core/step.py declares ``unique_indices=True`` + ``indices_are_sorted=
+True`` on the table-writeback scatters (the countermeasure to the TPU
+backend's serialized-scatter lowering, 2026-08-01).  Both are undefined
+behavior if false, and a CPU parity run would NOT catch a lie — XLA:CPU
+does not exploit the hints.  This test flips the step's trace-time
+check hook so every executed step records any wrow vector that is not
+strictly ascending (ascending + no duplicates ⇔ both promises), then
+drives the shapes most likely to break the invariant:
+
+- duplicate keys (many requests → one segment → one writer)
+- fresh inserts (winner-claimed rows mixed with existing rows)
+- table overfull (err rows are remapped to cap and sort LAST
+  into a non-exists segment)
+- invalid rows and mixed arrival times (the two-key sort path)
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.core import step as step_mod
+from gubernator_tpu.core.batch import RequestBatch
+from gubernator_tpu.core.step import decide_batch
+from gubernator_tpu.core.table import init_table
+
+i64 = jnp.int64
+NOW = 1_760_000_000_000
+
+
+def _mk(keys, now_col=None, valid=None):
+    n = len(keys)
+    return RequestBatch(
+        key=jnp.asarray(np.asarray(keys, dtype=np.uint64)),
+        hits=jnp.ones(n, i64), limit=jnp.full(n, 5, i64),
+        duration=jnp.full(n, 10_000, i64), eff_ms=jnp.full(n, 10_000, i64),
+        greg_end=jnp.zeros(n, i64), behavior=jnp.zeros(n, jnp.int32),
+        algorithm=jnp.zeros(n, jnp.int32), burst=jnp.full(n, 5, i64),
+        valid=jnp.asarray(valid if valid is not None else [True] * n),
+        now=None if now_col is None else jnp.asarray(now_col, i64))
+
+
+@pytest.fixture()
+def invariant_hook():
+    jax.clear_caches()  # cached traces predate the hook
+    step_mod._CHECK_SCATTER_INVARIANTS = True
+    step_mod._SCATTER_INVARIANT_VIOLATIONS.clear()
+    step_mod._SCATTER_INVARIANT_CHECKS[0] = 0
+    yield step_mod._SCATTER_INVARIANT_VIOLATIONS
+    step_mod._CHECK_SCATTER_INVARIANTS = False
+    jax.clear_caches()
+
+
+def test_wrow_strictly_ascending_under_adversarial_batches(invariant_hook):
+    rng = np.random.default_rng(5)
+    st = init_table(1 << 8)  # small: forces collisions and overfull errs
+
+    # duplicates + inserts + growing occupancy
+    for t in range(6):
+        keys = (rng.integers(1, 300, size=128)).astype(np.uint64)
+        st, out = decide_batch(st, _mk(keys), jnp.asarray(NOW + t, i64))
+    # overfull: distinct keys far beyond capacity → err rows (row -1)
+    keys = np.arange(1, 513, dtype=np.uint64) * 7919
+    st, out = decide_batch(st, _mk(keys), jnp.asarray(NOW + 10, i64))
+    assert bool(out.err.any()), "expected table-full err rows"
+    # invalid rows + mixed arrival times (the two-key sort path)
+    keys = rng.integers(1, 50, size=128).astype(np.uint64)
+    nows = NOW + 20 + rng.integers(0, 5, size=128)
+    valid = rng.random(128) > 0.2
+    st, out = decide_batch(st, _mk(keys, now_col=nows, valid=valid),
+                           jnp.asarray(NOW + 20, i64))
+    jax.block_until_ready(out.status)
+    jax.effects_barrier()  # debug.callback effects are NOT flushed by
+    # block_until_ready on async backends
+
+    assert step_mod._SCATTER_INVARIANT_CHECKS[0] >= 8, (
+        "the trace-time hook never fired — the test is vacuous")
+    assert not invariant_hook, (
+        f"{len(invariant_hook)} wrow vectors broke the scatter promises; "
+        f"first: {invariant_hook[0] if invariant_hook else None}")

@@ -2,112 +2,365 @@
 
 The tunnel wedges permanently if a client abandons an in-flight compile
 (ROUND_NOTES round 1), so when a chip IS reachable every open question
-must be answered in ONE session window, cheapest first.  This script
-runs that battery and writes /tmp/tpu_session.json as it goes (each
-stage's result lands immediately, so a later wedge loses nothing):
+must be answered in ONE session window — and the DRIVER-SHAPED record
+must land first (round 3 spent its only live window on exploratory
+duels and never reached the bench; VERDICT r3 item 2).
 
-  1. trivial-op probe (is the tunnel alive at all?)
-  2. step-mode duel at serving shapes: copy vs donated decide_batch at
-     CAP 2^21 (answers PERF.md §5.1 — does the TPU lowering update
-     in place, or serialize aliased scatters?)
-  3. capacity sweep in the winning mode: CAP 2^21 → 2^24 (is the
-     streaming wall broken — cost ~flat — or still linear?)
-  4. config-5 probe: one donated step at CAP 2^27 (does the 100M-key
-     table fit and run?)
-  5. scan superstep (on-chip rate, launch latency excluded)
-  6. full bench.py inner run (the driver-shaped JSON, both modes)
+Stage order (each stage is its OWN subprocess — the tunnel is
+single-client, so the orchestrator never imports jax; a stage exiting
+releases its client for the next):
 
-Usage (give it a LONG timeout — cold compiles took 444s in round 1;
-never ctrl-C an in-flight stage):
+  1. probe      — trivial op in a child (is the tunnel alive at all?)
+  2. cap_ab 22  — ONE compile: is the donated step still pathological
+                  at CAP 2^22 after the unique/sorted scatter promises?
+                  (VERDICT r3 item 1 — the question of the round.)
+  3. bench.py   — the FULL driver-shaped bench (headline duel at the
+                  north-star 10M-key/CAP 2^24 shape checkpoints itself
+                  immediately; sections follow, each child-isolated).
+                  Its JSON is mirrored into artifacts/ the moment it
+                  exists: a wedge ANYWHERE later still leaves a
+                  BENCH_rN-shaped TPU record on disk.
+  4. extras     — exploratory stages, cheapest-first: Pallas on-chip
+                  parity + cap21 timing (VERDICT item 3), LEAKY at
+                  serving scale (item 7), cap27 probe + Gregorian
+                  churn (item 6).
 
-    timeout 5400 python tools/tpu_session.py
+Every stage result is written to /tmp/tpu_session.json AND mirrored to
+artifacts/tpu_session_live.json (the repo workspace persists across
+sessions; /tmp does not).  After a stage timeout the orchestrator
+checks relay-port liveness (127.0.0.1:8103 — refused ⇒ relay dead)
+and aborts the battery instead of burning timeouts on a dead link.
+
+Usage (give it a LONG timeout — cold compiles are 200-300 s each,
+.jax_cache does NOT persist axon remote_compile results, and the
+internal stage budgets sum to ~13050 s before stall extensions; on
+SIGTERM the orchestrator kills the active stage's process group so no
+orphan can hold the single-client tunnel):
+
+    timeout 14400 python tools/tpu_session.py
 """
 import json
 import os
+import signal
+import socket
+import subprocess
 import sys
 import time
+from functools import partial
 
-# runnable as `python tools/tpu_session.py` from anywhere: the repo
-# root must be on sys.path before gubernator_tpu/bench imports
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
-import _jax_cache  # persistent compile cache (shared dir choice)
-
-_jax_cache.setup()
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.abspath(os.path.join(_HERE, ".."))
+sys.path.insert(0, _REPO)
 
 OUT = "/tmp/tpu_session.json"
+MIRROR = os.path.join(_REPO, "artifacts", "tpu_session_live.json")
 results: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
+
+
+def atomic_write_json(path, obj):
+    """Atomic checkpoint write; a write failure (full /tmp, bad path)
+    must cost the checkpoint, never the battery — the whole point of
+    checkpointing is surviving worse failures than this."""
+    try:
+        with open(path + ".tmp", "w") as f:
+            json.dump(obj, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError as e:
+        print(f"[tpu_session] write {path} failed: {e}", file=sys.stderr)
 
 
 def record(key, value):
     results[key] = value
-    with open(OUT, "w") as f:
-        json.dump(results, f, indent=1)
-    print(f"[tpu_session] {key}: {value}", file=sys.stderr, flush=True)
+    for path in (OUT, MIRROR):
+        atomic_write_json(path, results)
+    print(f"[tpu_session] {key}: {str(value)[:300]}", file=sys.stderr,
+          flush=True)
+
+
+_ACTIVE_STAGE_PID = None
+
+
+def _sigterm(signum, frame):
+    """An external timeout killing THIS process must not orphan the
+    active stage's process group — an orphaned jax client would hold
+    the single-client tunnel (and possibly an in-flight compile)
+    indefinitely."""
+    if _ACTIVE_STAGE_PID is not None:
+        try:
+            os.killpg(_ACTIVE_STAGE_PID, 9)
+        except OSError:
+            pass
+    record("aborted_by_signal", signum)
+    sys.exit(1)
+
+
+def relay_alive(port=8103, timeout=5) -> bool:
+    """The axon backend's only path is a local stdio relay; when the
+    relay process dies every relay port refuses and jax.devices() hangs
+    forever.  A raw connect answers 'is there any point probing JAX'
+    without spending a JAX hang timeout."""
+    s = socket.socket()
+    s.settimeout(timeout)
+    try:
+        s.connect(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def run_stage(name, argv, timeout, env_extra=None, progress_file=None,
+              stall_timeout=900):
+    """Run one battery stage as its own PROCESS GROUP.  Returns
+    (ok, stdout).
+
+    Killing a healthy child mid-remote-compile is the known permanent
+    tunnel-wedge mechanism, so a stage is only killed when it is
+    actually stuck, not merely slow: with a `progress_file` (the
+    stage's own progressive checkpoint) the deadline extends as long as
+    the file keeps advancing, and the kill fires only after
+    `stall_timeout` seconds with NO checkpoint progress past the hard
+    deadline.  The kill targets the whole process group — bench.py's
+    watchdog spawns inner/section grandchildren, and an orphaned
+    grandchild would silently hold the single-client tunnel and starve
+    every later stage."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE, cwd=_REPO,
+                            env=env, start_new_session=True)
+    global _ACTIVE_STAGE_PID
+    _ACTIVE_STAGE_PID = proc.pid
+
+    def progress_mtime():
+        try:
+            return os.path.getmtime(progress_file)
+        except OSError:
+            return 0.0
+
+    killed = None
+    while True:
+        try:
+            proc.wait(timeout=15)
+            break
+        except subprocess.TimeoutExpired:
+            pass
+        now = time.time()
+        if now - t0 < timeout:
+            continue
+        if progress_file and now - max(progress_mtime(), t0) < stall_timeout:
+            continue  # past deadline but still checkpointing: let it run
+        killed = round(now - t0, 1)
+        try:
+            os.killpg(proc.pid, 9)
+        except OSError:
+            proc.kill()
+        proc.wait()
+        break
+    out = (proc.stdout.read() or b"").decode(errors="replace")
+    dt = round(time.time() - t0, 1)
+    if killed is not None:
+        record(f"{name}__stage", {
+            "rc": "timeout", "seconds": killed,
+            "partial_stdout": out[-500:]})
+        return False, out
+    record(f"{name}__stage", {"rc": proc.returncode, "seconds": dt})
+    return proc.returncode == 0, out
+
+
+def merge_json_file(key, path, not_before):
+    """Pull a stage's own checkpoint file into the session record (the
+    stage wrote it progressively, so it survives the stage dying).
+    Checkpoint paths are fixed, so a file older than the stage's start
+    is a PREVIOUS run's data — recording it would publish stale numbers
+    as this session's (same freshness rule as bench's salvage_partial)."""
+    try:
+        if os.path.getmtime(path) < not_before:
+            record(key, {"error": f"checkpoint at {path} predates this "
+                                  "stage (stale run) — discarded"})
+            return False
+        with open(path) as f:
+            record(key, json.load(f))
+        return True
+    except (OSError, ValueError) as e:
+        record(key, {"error": f"no checkpoint at {path}: {e}"})
+        return False
 
 
 def main() -> int:
+    signal.signal(signal.SIGTERM, _sigterm)
+    if not relay_alive():
+        record("abort", "relay port 8103 refused — tunnel relay is "
+                        "dead; nothing to measure")
+        return 1
+
+    # 1. probe: trivial op in a child (150 s: a live-but-degraded link
+    # can take tens of seconds; a wedge hangs forever)
+    ok, out = run_stage("probe", [
+        sys.executable, "-c",
+        "import jax, json; "
+        "print(json.dumps({'backend': jax.default_backend(), "
+        "'sum': int(jax.numpy.arange(8).sum())}))"], timeout=150)
+    if not ok:
+        record("abort", "probe failed/hung — not spending compiles")
+        return 1
+    try:
+        probe = json.loads(out.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        probe = {"raw": out[-200:]}
+    record("probe", probe)
+    if probe.get("backend") != "tpu":
+        record("abort", f"backend is {probe.get('backend')}, not tpu")
+        return 1
+
+    # 2. the scatter-pathology question: ONE compile at CAP 2^22
+    # (~5 min cold).  cap_ab writes /tmp/cap_ab.json progressively.
+    t_capab = time.time()
+    ok, _ = run_stage("cap_ab22", [sys.executable,
+                                   os.path.join(_HERE, "cap_ab.py"),
+                                   "22"], timeout=1500,
+                      progress_file="/tmp/cap_ab.json")
+    merge_json_file("cap_ab22", "/tmp/cap_ab.json", t_capab)
+    if not ok and not relay_alive():
+        record("abort", "relay died during cap_ab — battery over; "
+                        "commit what landed")
+        return 1
+
+    # 3. THE DRIVER-SHAPED BENCH — before any exploratory stage.  The
+    # headline duel (copy/donate/pallas at 10M keys / CAP 2^24) is the
+    # north-star answer AND the BENCH_rN record; bench checkpoints it
+    # to the partial file immediately after the duel, so even a bench
+    # death at minute 30 leaves a driver-parseable fragment here.
+    partial = "/tmp/guber_bench_partial_session.json"
+    # bench.py's own watchdog budgets 5400 s for the device attempt +
+    # 1800 s CPU fallback + probes; the stage timeout must sit OUTSIDE
+    # that so the watchdog's salvage machinery (not our kill) decides
+    bench_timeout = int(os.environ.get("GUBER_SESSION_BENCH_TIMEOUT",
+                                       "7800"))
+    t_bench = time.time()
+    ok, out = run_stage("bench", [sys.executable,
+                                  os.path.join(_REPO, "bench.py")],
+                        timeout=bench_timeout,
+                        env_extra={"GUBER_BENCH_PARTIAL": partial},
+                        progress_file=partial)
+    lines = [ln for ln in out.strip().splitlines()
+             if ln.startswith("{")]
+    if ok and lines:
+        try:
+            record("bench", json.loads(lines[-1]))
+        except ValueError:
+            record("bench", {"error": "unparseable final line",
+                             "raw": lines[-1][:500]})
+            merge_json_file("bench_partial", partial, t_bench)
+    else:
+        # died or timed out: the partial checkpoint IS the record
+        merge_json_file("bench_partial", partial, t_bench)
+    if not relay_alive():
+        record("abort", "relay died during/after bench — battery over")
+        return 1
+
+    # 4. exploratory extras (own subprocess, own progressive file).
+    # ~5 cold compiles at the observed 250-440 s worst case plus
+    # populate loops: the hard deadline assumes a warmish path, the
+    # progress extension covers a slow-but-advancing cold one.
+    extras_out = "/tmp/tpu_session_extras.json"
+    t_extras = time.time()
+    run_stage("extras", [sys.executable, os.path.abspath(__file__),
+                         "--extras"], timeout=3600,
+              env_extra={"GUBER_SESSION_EXTRAS_OUT": extras_out},
+              progress_file=extras_out)
+    merge_json_file("extras", extras_out, t_extras)
+
+    record("finished", time.strftime("%Y-%m-%d %H:%M:%S"))
+    print(json.dumps(results, indent=1))
+    return 0
+
+
+# ---- extras stage (runs as its own subprocess) --------------------------
+
+
+def extras() -> int:
+    import _jax_cache
+
+    _jax_cache.setup()
+
+    out_path = os.environ.get("GUBER_SESSION_EXTRAS_OUT",
+                              "/tmp/tpu_session_extras.json")
+    #: second progressive mirror in the repo workspace: the extras rows
+    #: survive on disk even if the orchestrator dies before its merge
+    mirror = os.path.join(_REPO, "artifacts", "tpu_session_extras_live.json")
+    ex: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
+
+    def rec(key, value):
+        ex[key] = value
+        atomic_write_json(out_path, ex)
+        atomic_write_json(mirror, ex)
+        print(f"[extras] {key}: {str(value)[:300]}", file=sys.stderr,
+              flush=True)
+
+    plat = os.environ.get("GUBER_JAX_PLATFORM", "")
     import jax
+
+    if plat:
+        # the sandbox sitecustomize overwrites the jax_platforms config
+        # at interpreter start (env is ignored) — same dance as bench.py
+        jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
     import numpy as np
 
-    t0 = time.time()
-    backend = jax.default_backend()
-    x = int(jnp.arange(8).sum())
-    record("probe", {"backend": backend, "sum": x,
-                     "seconds": round(time.time() - t0, 1)})
-    if backend != "tpu":
-        record("abort", f"backend is {backend}, not tpu")
-        return 1
-
+    from bench import _keyhash as keyhash, pad_chunk
     from gubernator_tpu.core.batch import RequestBatch
     from gubernator_tpu.core.step import decide_batch, decide_batch_donated
     from gubernator_tpu.core.table import init_table
 
-    # share the bench's key distribution + populate padding, so these
-    # answers apply verbatim to the driver's bench run
-    from bench import _keyhash as keyhash, pad_chunk
+    #: GUBER_EXTRAS_SMOKE: run every stage at toy shapes on any backend
+    #: (offline dry-run of the battery code — a typo here would
+    #: otherwise burn a live tunnel window)
+    smoke = bool(os.environ.get("GUBER_EXTRAS_SMOKE"))
+    if jax.default_backend() != "tpu" and not smoke:
+        rec("abort", f"backend {jax.default_backend()}")
+        return 1
 
     i64 = jnp.int64
-    B = int(os.environ.get("GUBER_BENCH_B", 65536))
+    B = (256 if smoke
+         else int(os.environ.get("GUBER_BENCH_B", 65536)))
     rng = np.random.default_rng(42)
+    NOW = 1_760_000_000_000
 
-    def mk(keys):
+    def mk(keys, **over):
         n = keys.shape[0]
-        return RequestBatch(
+        base = dict(
             key=jnp.asarray(keys), hits=jnp.ones(n, i64),
             limit=jnp.full(n, 100, i64), duration=jnp.full(n, 10_000, i64),
             eff_ms=jnp.full(n, 10_000, i64), greg_end=jnp.zeros(n, i64),
             behavior=jnp.zeros(n, jnp.int32),
             algorithm=jnp.zeros(n, jnp.int32),
             burst=jnp.full(n, 100, i64), valid=jnp.ones(n, bool))
+        base.update(over)
+        return RequestBatch(**base)
 
-    NOW = 1_760_000_000_000
-
-    # Transfer-free hot loops: per-rep `jnp.asarray(NOW + r)` is a
-    # SYNCHRONOUS host→device round trip over the axon tunnel (measured
-    # 2026-08-01: ~26-216 ms per transfer on a degraded link, while
-    # chained dispatch pipelines at 0.02 ms/step) — it turns every
-    # sustained loop into a link-RTT measurement.  `now` lives on device
-    # and advances with a jitted +1 instead (identical time semantics).
     bump1 = jax.jit(lambda tt: tt + 1)
-    bump1(jnp.asarray(0, i64)).block_until_ready()  # compile up front:
-    # never inside a timed region (cap27 uses it before any measure())
+    bump1(jnp.asarray(0, i64)).block_until_ready()
 
-    def measure(step_fn, cap, n_keys, label, reps=64,
-                init_fn=init_table):
+    def measure(step_fn, cap, n_keys, label, reps=64, init_fn=init_table,
+                mk_over=None):
+        if smoke:
+            cap, n_keys, reps = 1 << 12, 2048, 4
         st = init_fn(cap)
+        over = mk_over or {}
         batches = [mk(keyhash((rng.zipf(1.1, size=B) % n_keys)
-                              .astype(np.uint64))) for _ in range(4)]
+                              .astype(np.uint64)), **over)
+                   for _ in range(4)]
         now0 = jnp.asarray(NOW, i64)
         t = time.time()
         st, out = step_fn(st, batches[0], now0)
         out.status.block_until_ready()
         compile_s = round(time.time() - t, 1)
-        # populate (same padding policy as bench.populate)
         ids = np.arange(n_keys, dtype=np.uint64)
         for a in range(0, n_keys, B):
             ch = pad_chunk(ids[a:a + B], B)
-            st, out = step_fn(st, mk(keyhash(ch)), now0)
+            st, out = step_fn(st, mk(keyhash(ch), **over), now0)
         out.status.block_until_ready()
         now_dev = bump1(now0)
         t = time.time()
@@ -116,89 +369,100 @@ def main() -> int:
             now_dev = bump1(now_dev)
         out.status.block_until_ready()
         dt = time.time() - t
-        rate = reps * B / dt
-        # honest rate: err rows (table/bucket overflow) are NOT served
-        # decisions — the fraction rides every row so a reader can see
-        # whether a mode's rate covers the whole working set (the
-        # pallas kernel's 8-slot buckets overflow sooner than the XLA
-        # probe window)
         err_frac = round(float(np.asarray(out.err).mean()), 6)
-        record(label, {"decisions_per_s": round(rate),
-                       "ms_per_step": round(dt / reps * 1e3, 3),
-                       "compile_s": compile_s, "cap": cap,
-                       "n_keys": n_keys, "B": B,
-                       "err_fraction": err_frac})
-        return rate
+        rec(label, {"decisions_per_s": round(reps * B / dt),
+                    "ms_per_step": round(dt / reps * 1e3, 3),
+                    "compile_s": compile_s, "cap": cap,
+                    "n_keys": n_keys, "B": B,
+                    "err_fraction": err_frac})
+        return reps * B / dt
 
     def stage(label, thunk, retries=1):
-        """Stage isolation: one flaky remote_compile (observed
-        2026-08-01: 'response body closed before all bytes were read'
-        mid-compile) must cost ONE stage, not the battery.  Retries
-        once after a settle pause; two total failures record an error
-        row and the battery moves on."""
+        """One flaky remote_compile must cost ONE stage, not the
+        battery (observed: 'response body closed before all bytes were
+        read' mid-compile)."""
         for attempt in range(retries + 1):
             try:
                 return thunk()
             except Exception as e:  # noqa: BLE001
-                err = f"attempt {attempt + 1}: {str(e)[:300]}"
-                record(f"{label}__error{attempt + 1}", err)
-                if attempt < retries:  # settle pause only before a retry
+                rec(f"{label}__error{attempt + 1}",
+                    f"attempt {attempt + 1}: {str(e)[:300]}")
+                if attempt < retries:
                     time.sleep(20)
         return None
 
-    # 2. step-mode duel at CAP 2^21 (1M keys)
-    r_copy = stage("copy_cap21", lambda: measure(
-        decide_batch, 1 << 21, 1_000_000, "copy_cap21")) or 0.0
-    r_don = stage("donate_cap21", lambda: measure(
-        decide_batch_donated, 1 << 21, 1_000_000, "donate_cap21")) or 0.0
-    winner = decide_batch_donated if r_don > r_copy else decide_batch
-    record("step_mode", "donate" if r_don > r_copy else "copy")
+    # 4a. Pallas decision kernel (VERDICT r3 item 3): on-chip parity
+    # spot-check vs the XLA step (TOKEN and LEAKY batches), then cap21
+    # timing.  (bench's duel already timed it at the CAP 2^24 shape.)
+    def pallas_parity(label, over_fn=None):
+        """512-key on-chip spot-check: kernel vs XLA step, all output
+        fields.  `over_fn(n)` builds batch-field overrides at the
+        parity size.  Returns True iff every field matched (records
+        either way)."""
+        try:
+            from gubernator_tpu.ops.pallas_step import (
+                decide_batch_pallas, init_pallas_table)
 
-    # 3. capacity sweep in the winning mode (is cost flat in CAP?)
-    stage("win_cap22", lambda: measure(winner, 1 << 22, 2_000_000,
-                                       "win_cap22"))
-    stage("win_cap24", lambda: measure(winner, 1 << 24, 10_000_000,
-                                       "win_cap24"))
+            npar = 512
+            ksm = keyhash(np.arange(1, npar + 1, dtype=np.uint64))
+            over = over_fn(npar) if over_fn else {}
+            pt = init_pallas_table(1 << 12)
+            stx = init_table(1 << 12)
+            pt, po = decide_batch_pallas(pt, mk(ksm, **over),
+                                         jnp.asarray(NOW, i64),
+                                         interpret=smoke)
+            stx, xo = decide_batch(stx, mk(ksm, **over),
+                                   jnp.asarray(NOW, i64))
+            mismatch = [f for f in ("status", "remaining", "reset_time",
+                                    "limit")
+                        if not bool((getattr(po, f)
+                                     == getattr(xo, f)).all())]
+            rec(label, {"ok": not mismatch,
+                        "mismatch_fields": mismatch})
+            return not mismatch
+        except Exception as e:  # noqa: BLE001
+            rec(label, {"ok": False, "error": str(e)[:400]})
+            return False
 
-    # 3b. Pallas decision kernel (VERDICT r2 item 4): does the Mosaic
-    # lowering compile on real hardware, does it match the XLA step
-    # bit-for-bit on-chip, and what floor does it measure?  Isolated:
-    # a Mosaic failure must not cost the remaining stages.
-    try:
-        from gubernator_tpu.ops.pallas_step import (decide_batch_pallas,
-                                                    init_pallas_table)
+    if pallas_parity("pallas_step"):
+        try:
+            from gubernator_tpu.ops.pallas_step import (
+                decide_batch_pallas, init_pallas_table)
 
-        # on-chip parity spot-check before any timing
-        ksm = keyhash(np.arange(1, 513, dtype=np.uint64))
-        pt = init_pallas_table(1 << 12)
-        stx = init_table(1 << 12)
-        pt, po = decide_batch_pallas(pt, mk(ksm), jnp.asarray(NOW, i64))
-        stx, xo = decide_batch(stx, mk(ksm), jnp.asarray(NOW, i64))
-        mismatch = [f for f in ("status", "remaining", "reset_time",
-                                "limit")
-                    if not bool((getattr(po, f)
-                                 == getattr(xo, f)).all())]
-        if mismatch:
-            record("pallas_step", {"ok": False,
-                                   "mismatch_fields": mismatch})
-        else:
-            # 2× rows like bench's duel: the 8-slot buckets need the
-            # headroom (the row's err_fraction shows what remains).
-            # The row's "cap" field is the XLA-comparable parameter;
-            # table_rows records what the kernel actually used.
-            cap_p = 1 << 21
-            measure(decide_batch_pallas, cap_p, 1_000_000,
+            cap_p = 1 << 12 if smoke else 1 << 21
+            pal = (partial(decide_batch_pallas, interpret=True)
+                   if smoke else decide_batch_pallas)
+            measure(pal, cap_p, 1_000_000,
                     "pallas_cap21", reps=16,
                     init_fn=lambda cap: init_pallas_table(cap * 2))
-            record("pallas_step", {"ok": True,
-                                   "table_rows": cap_p * 2})
-    except Exception as e:  # noqa: BLE001
-        record("pallas_step", {"ok": False, "error": str(e)[:400]})
+        except Exception as e:  # noqa: BLE001
+            rec("pallas_cap21__error", str(e)[:400])
 
-    # 4. config-5 probe: CAP 2^27 fits only donated (one table copy)
+    # LEAKY parity (round-4 kernel extension): the same spot-check on
+    # an all-LEAKY batch.
+    def leaky_over(n):
+        return dict(algorithm=jnp.ones(n, jnp.int32),
+                    limit=jnp.full(n, 10**6, i64),
+                    burst=jnp.full(n, 10**6, i64),
+                    duration=jnp.full(n, 60_000, i64),
+                    eff_ms=jnp.full(n, 60_000, i64))
+
+    pallas_parity("pallas_leaky", leaky_over)
+
+    # 4b. LEAKY at serving scale (VERDICT r3 item 7): config 2 has had
+    # no on-chip number since round 1.  1M keys / CAP 2^21 / B=65536 in
+    # the donate mode — one compile.
+    stage("leaky_cap21", lambda: measure(
+        decide_batch_donated, 1 << 21, 1_000_000, "leaky_cap21",
+        mk_over=leaky_over(B)))
+
+    # 4c. config-5: CAP 2^27 residence probe + TRUE Gregorian/RESET
+    # churn (VERDICT r3 item 6 — re-measure post scatter fix)
     try:
-        st5 = init_table(1 << 27)
-        k5 = mk(keyhash(rng.integers(0, 100_000_000, size=B)
+        cap5, u5 = ((1 << 14, 10_000) if smoke
+                    else (1 << 27, 100_000_000))
+        st5 = init_table(cap5)
+        k5 = mk(keyhash(rng.integers(0, u5, size=B)
                         .astype(np.uint64)))
         t = time.time()
         st5, out = decide_batch_donated(st5, k5, jnp.asarray(NOW, i64))
@@ -210,12 +474,9 @@ def main() -> int:
             st5, out = decide_batch_donated(st5, k5, now_dev)
             now_dev = bump1(now_dev)
         out.status.block_until_ready()
-        record("cap27_probe", {
+        rec("cap27_probe", {
             "ok": True, "first_step_s": round(first, 1),
             "decisions_per_s": round(8 * B / (time.time() - t))})
-        # 4b. the ACTUAL config-5 workload at 2^27 (VERDICT r2 item 5):
-        # Gregorian expirations + RESET_REMAINING churn, not just
-        # capacity residence — reuses the live 2^27 table
         try:
             from gubernator_tpu.gregorian import gregorian_expiration
             from gubernator_tpu.types import Behavior, GregorianDuration
@@ -225,64 +486,39 @@ def main() -> int:
             beh = np.full(B, int(Behavior.DURATION_IS_GREGORIAN),
                           np.int32)
             beh[::37] |= int(Behavior.RESET_REMAINING)
-            kg = keyhash(rng.integers(0, 100_000_000, size=B)
+            kg = keyhash(rng.integers(0, u5, size=B)
                          .astype(np.uint64))
-            bg = RequestBatch(
-                key=jnp.asarray(kg), hits=jnp.ones(B, i64),
-                limit=jnp.full(B, 100, i64),
-                duration=jnp.full(B, int(GregorianDuration.HOURS), i64),
-                eff_ms=jnp.full(B, 3_600_000, i64),
-                greg_end=jnp.full(B, greg_end, i64),
-                behavior=jnp.asarray(beh),
-                algorithm=jnp.zeros(B, jnp.int32),
-                burst=jnp.full(B, 100, i64), valid=jnp.ones(B, bool))
+            bg = mk(kg, behavior=jnp.asarray(beh),
+                    duration=jnp.full(B, int(GregorianDuration.HOURS),
+                                      i64),
+                    eff_ms=jnp.full(B, 3_600_000, i64),
+                    greg_end=jnp.full(B, greg_end, i64))
             st5, out = decide_batch_donated(st5, bg,
                                             jnp.asarray(NOW, i64))
-            out.status.block_until_ready()  # compile
+            out.status.block_until_ready()
             now_dev = jnp.asarray(NOW + 1, i64)
             t = time.time()
             for r in range(8):
                 st5, out = decide_batch_donated(st5, bg, now_dev)
                 now_dev = bump1(now_dev)
             out.status.block_until_ready()
-            record("cap27_gregorian_churn", {
-                "ok": True, "capacity": 1 << 27,
+            rec("cap27_gregorian_churn", {
+                "ok": True, "capacity": cap5,
                 "decisions_per_s": round(8 * B / (time.time() - t))})
         except Exception as e:  # noqa: BLE001
-            record("cap27_gregorian_churn", {"ok": False,
-                                             "error": str(e)[:300]})
+            rec("cap27_gregorian_churn", {"ok": False,
+                                          "error": str(e)[:300]})
         del st5
     except Exception as e:  # noqa: BLE001
-        record("cap27_probe", {"ok": False, "error": str(e)[:300]})
+        rec("cap27_probe", {"ok": False, "error": str(e)[:300]})
 
-    # 5+6. the full driver-shaped bench (scan superstep, latency,
-    # secondary configs, clustered service) in this same window.
-    # Never SIGKILL it mid-compile (that's the tunnel-wedge mechanism):
-    # the inner timeout is generous and expiry is RECORDED, not fatal —
-    # stages 1–4 above already answered the load-bearing questions.
-    os.environ["GUBER_BENCH_INNER"] = "1"
-    import subprocess
-
-    bench_timeout = int(os.environ.get("GUBER_SESSION_BENCH_TIMEOUT",
-                                       "5400"))
-    try:
-        r = subprocess.run([sys.executable,
-                            os.path.join(os.path.dirname(__file__), "..",
-                                         "bench.py")],
-                           stdout=subprocess.PIPE, timeout=bench_timeout)
-        line = (r.stdout or b"").decode().strip().splitlines()
-        record("bench", json.loads(line[-1]) if line and
-               line[-1].startswith("{") else {"error": "no JSON line"})
-    except subprocess.TimeoutExpired as e:
-        partial = (e.stdout or b"").decode(errors="replace")[-1000:]
-        record("bench", {"error": f"timed out after {bench_timeout}s "
-                                  "(tunnel may now be wedged — probe "
-                                  "before any further TPU work)",
-                         "partial_stdout": partial})
+    rec("finished", time.strftime("%Y-%m-%d %H:%M:%S"))
     return 0
 
 
 if __name__ == "__main__":
+    if "--extras" in sys.argv:
+        sys.exit(extras())
     try:
         sys.exit(main())
     except Exception as e:  # noqa: BLE001

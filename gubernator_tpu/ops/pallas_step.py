@@ -31,12 +31,29 @@ Design (TPU-first, not a translation):
   burst, remaining) are host-qualified to < 2^30 and use plain i32
   arithmetic.
 
-Domain (host-checked by ``pallas_qualifies``): TOKEN_BUCKET only —
-LEAKY's td fixed point needs 64-bit multiply/divide, which this
-prototype does not implement (the XLA modes serve it).  All TOKEN
-behaviors are supported: RESET_REMAINING, DRAIN_OVER_LIMIT,
-DURATION_IS_GREGORIAN (greg_end is a precomputed column), hits==0
-queries, mixed per-request `now`.
+Domain (host-checked by ``pallas_qualifies``): TOKEN_BUCKET and
+LEAKY_BUCKET.  All behaviors are supported: RESET_REMAINING,
+DRAIN_OVER_LIMIT, DURATION_IS_GREGORIAN (greg_end / eff_ms are
+precomputed columns), hits==0 queries, mixed per-request `now`.
+
+LEAKY's td fixed point (oracle.apply_leaky: remaining stored as
+``remaining × eff`` in int64 "token-duration" units) runs in paired-i32
+arithmetic:
+
+- every REQUEST-only td product (``hits×eff``, ``burst×eff``,
+  ``limit×eff``, ``eff//limit``, ``TD_BOUND//limit``) is precomputed as
+  an int64 column by the XLA wrapper — real 64-bit hardware, masked to
+  eff=1 on token rows exactly like core/step.py's ``eff_l`` operand
+  masking;
+- the two STATE-dependent ops run in-kernel: ``elapsed × limit`` via an
+  unsigned 32×32→64 multiply built from 16-bit halves (``_umul32x32``),
+  and ``td // eff`` (+ the rescale divmods) via a 32-step restoring
+  division (``_udiv64_32``) whose quotient provably fits one word: the
+  domain bounds counters < 2^30 and leaky eff < 2^31 (``EFF_BOUND``),
+  so td < 2^30 × eff and every quotient < 2^31.
+
+The divisions live only in the ``pl.when`` leaky branch — token tiles
+pay nothing for them.
 
 Use ``interpret=True`` (or the CPU backend) for the reference
 interpreter used by the parity tests.
@@ -54,7 +71,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..core.batch import RequestBatch
 from ..core.step import StepOutput
-from ..types import Behavior
+from ..types import TD_BOUND, Behavior
 
 SLOTS = 8  # probe window = one bucket
 WORDS = 32  # i32 words per row (128 B — DMA-friendly, room to grow)
@@ -63,6 +80,15 @@ TILE = 128  # requests per grid step
 #: value bound for i32 counter arithmetic (limit-change adjustment adds
 #: two limits before clipping, so 2^30 keeps every intermediate in i32)
 VALUE_BOUND = 1 << 30
+
+#: leaky eff_ms bound (~24.8 days): keeps the division divisor in one
+#: i32 word and, with VALUE_BOUND, every td quotient < 2^31.  Also puts
+#: both denominators under oracle FRAC_SAFE (2^31), so the kernel's
+#: rescale ALWAYS keeps the sub-token fraction — no floor branch —
+#: and under TD_BOUND//eff ≥ 2^30 ≥ any whole-token count, so the
+#: oracle's whole-token clamp is a domain no-op.  Longer windows are
+#: DURATION_IS_GREGORIAN's job (fixed-rate eff) or the XLA modes'.
+EFF_BOUND = 1 << 31
 
 _RESET = int(Behavior.RESET_REMAINING)
 _DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
@@ -75,8 +101,12 @@ W_TLO, W_THI = 5, 6
 W_XLO, W_XHI = 7, 8  # expire_at
 W_ELO, W_EHI = 9, 10  # eff_ms
 W_DLO, W_DHI = 11, 12  # duration
-# words 13..31: reserved (leaky td state, burst, alg when the kernel
-# grows past the token domain)
+W_ALG = 13  # 0 token / 1 leaky (empty slot = 0: insert is fresh anyway)
+W_TDLO, W_TDHI = 14, 15  # leaky remaining, td units (= remaining × eff)
+# words 16..31: reserved
+# (item.burst is NOT stored: oracle.apply_leaky overwrites it from the
+# request before every read, so the replenish cap is the request-only
+# burst×eff column)
 
 #: python int, not a jnp constant: a module-level traced array would be
 #: captured by the kernel closure, which pallas_call rejects
@@ -133,6 +163,69 @@ def _sel64(c, ah, al, bh, bl):
     return jnp.where(c, ah, bh), jnp.where(c, al, bl)
 
 
+def _sub64(ah, al, bh, bl):
+    """(ah:al) - (bh:bl), callers guarantee a >= b."""
+    borrow = _ult(al, bl).astype(jnp.int32)
+    return ah - bh - borrow, al - bl
+
+
+def _umul32x32(a, b):
+    """Unsigned 32×32→64 multiply from 16-bit halves: ``a`` is any u32
+    word, ``b`` must be < 2^31 (true of every multiplier here: limit
+    < VALUE_BOUND, eff < EFF_BOUND).  Mosaic's i32 multiply yields the
+    low 32 product bits, which for 16-bit partials IS the exact
+    unsigned value."""
+    i32 = jnp.int32
+    mask = i32(0xFFFF)
+    ah, al = (a >> 16) & mask, a & mask
+    bh, bl = (b >> 16) & mask, b & mask  # bh < 2^15 given b < 2^31
+    t = al * bl           # < 2^32 (exact bits in the word)
+    u = ah * bl           # < 2^32
+    v = al * bh           # < 2^31
+    w = ah * bh           # < 2^31
+    lo1 = t + (u << 16)
+    c1 = _ult(lo1, t).astype(i32)
+    lo2 = lo1 + (v << 16)
+    c2 = _ult(lo2, lo1).astype(i32)
+    hi = w + ((u >> 16) & mask) + ((v >> 16) & mask) + c1 + c2
+    return hi, lo2
+
+
+def _umul64x32(ah, al, m):
+    """(ah:al) × m for results the caller guarantees < 2^63 (here:
+    elapsed ≤ TD_BOUND//limit, so elapsed×limit ≤ TD_BOUND < 2^62) —
+    the ah×m high bits then provably vanish and the wrapping i32
+    multiply is exact."""
+    hi, lo = _umul32x32(al, m)
+    return hi + ah * m, lo
+
+
+def _udiv64_32(nh, nl, d):
+    """(nh:nl) ÷ d → (quotient, remainder), both one u32 word.
+
+    32-step restoring division (shift/compare/subtract only — Mosaic
+    lowers no 64-bit divide, and i32 divide lowerings are float-backed).
+    Exact under the precondition nh < d (⟺ quotient < 2^32), which the
+    leaky domain guarantees: every dividend < 2^31 × divisor
+    (td < 2^30×eff, frac×eff < eff×2^31).  Outside the precondition
+    (e.g. a discarded token-lane divisor) the result is garbage but the
+    loop is still well-defined — callers select it away."""
+    i32 = jnp.int32
+
+    def step(_, c):
+        R, Q, L = c
+        msb = (L >> 31) & i32(1)
+        L = L << 1
+        R = (R << 1) | msb
+        geq = _uge(R, d)
+        R = jnp.where(geq, R - d, R)
+        Q = (Q << 1) | geq.astype(i32)
+        return R, Q, L
+
+    R, Q, _ = lax.fori_loop(0, 32, step, (nh, i32(0), nl))
+    return Q, R
+
+
 def _split64(x):
     u = x.astype(jnp.uint64)
     hi = (u >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
@@ -161,7 +254,8 @@ def init_pallas_table(capacity: int) -> PallasTable:
 
 def pallas_qualifies(batch: RequestBatch) -> bool:
     """Host-side domain check (np, cheap): every valid row TOKEN_BUCKET
-    with counter values inside the i32-arithmetic bound, and per-key
+    or LEAKY_BUCKET with counter values inside the i32-arithmetic
+    bound, leaky eff_ms inside the one-word divisor bound, and per-key
     arrival times non-decreasing in batch order (the kernel applies
     requests strictly in batch order, where the XLA path re-sorts each
     key's segment by arrival time — a time-inverted duplicate pair
@@ -170,11 +264,16 @@ def pallas_qualifies(batch: RequestBatch) -> bool:
 
     v = np.asarray(batch.valid)
     alg = np.asarray(batch.algorithm)
-    if (v & (alg != 0)).any():
+    if (v & (alg != 0) & (alg != 1)).any():
         return False
     for col in (batch.hits, batch.limit, batch.burst):
         c = np.asarray(col)
         if ((v) & ((c < 0) | (c >= VALUE_BOUND))).any():
+            return False
+    leaky = v & (alg == 1)
+    if leaky.any():
+        eff = np.asarray(batch.eff_ms)
+        if (leaky & ((eff < 1) | (eff >= EFF_BOUND))).any():
             return False
     if batch.now is not None:
         now = np.asarray(batch.now)
@@ -198,6 +297,8 @@ def pallas_qualifies(batch: RequestBatch) -> bool:
 def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
             dlo_ref, dhi_ref, elo_ref, ehi_ref, glo_ref, ghi_ref,
             beh_ref, nlo_ref, nhi_ref, valid_ref,
+            alg_ref, htl_ref, hth_ref, cpl_ref, cph_ref,
+            rsl_ref, rsh_ref, rate_ref, gdl_ref, gdh_ref,
             _table_in, table_ref, st_o, rem_o, rlo_o, rhi_o, lim_o,
             flg_o, scratch, sem_in, sem_out):
     """One grid step = one TILE of requests, strictly in order.
@@ -272,18 +373,20 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
 
             # item state (insert reads the zeroed empty slot → fresh
             # fires below, matching the XLA path's post-insert read)
-            it_rem, it_status, it_limit = (pick(W_REM), pick(W_STATUS),
-                                           pick(W_LIMIT))
+            it_rem, it_status = pick(W_REM), pick(W_STATUS)
+            it_limit, it_alg = pick(W_LIMIT), pick(W_ALG)
             it_tlo, it_thi = pick(W_TLO), pick(W_THI)
             it_xlo, it_xhi = pick(W_XLO), pick(W_XHI)
             it_elo, it_ehi = pick(W_ELO), pick(W_EHI)
             it_dlo, it_dhi = pick(W_DLO), pick(W_DHI)
+            it_tdlo, it_tdhi = pick(W_TDLO), pick(W_TDHI)
 
             # request fields
             r_hits, r_lim = hits_ref[0, 0, j], lim_ref[0, 0, j]
             r_dlo, r_dhi = dlo_ref[0, 0, j], dhi_ref[0, 0, j]
             r_elo, r_ehi = elo_ref[0, 0, j], ehi_ref[0, 0, j]
             r_glo, r_ghi = glo_ref[0, 0, j], ghi_ref[0, 0, j]
+            r_alg = alg_ref[0, 0, j]
             beh = beh_ref[0, 0, j]
             is_greg = (beh & _GREG) != 0
             reset = (beh & _RESET) != 0
@@ -294,83 +397,208 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
             use_req = _ge64(nhi0, nlo0, it_thi, it_tlo)
             nhi1, nlo1 = _sel64(use_req, nhi0, nlo0, it_thi, it_tlo)
 
-            # fresh: empty/expired (alg change impossible: token-only)
-            fresh = (~found) | _ge64(nhi1, nlo1, it_xhi, it_xlo)
-            # token duration change → recompute expiry from item.t
-            dur_change = (~fresh) & _neq64(r_dhi, r_dlo, it_dhi, it_dlo)
-            ne_hi, ne_lo = _add64(it_thi, it_tlo, r_ehi, r_elo)
-            ne_hi, ne_lo = _sel64(is_greg, r_ghi, r_glo, ne_hi, ne_lo)
-            x1hi, x1lo = _sel64(dur_change, ne_hi, ne_lo, it_xhi, it_xlo)
-            fresh = fresh | (dur_change & ~_ge64(x1hi, x1lo, nhi1, nlo1)
-                             ) | (dur_change & _ge64(nhi1, nlo1, x1hi,
-                                                     x1lo))
-            # (exp1 <= now  ≡  now >= exp1; the first disjunct above is
-            # exp1 < now via !(exp1 >= now) — keep both for exactness
-            # with oracle's `exp1 <= now`)
-
-            # adopt fresh or existing
-            xf_hi, xf_lo = _add64(nhi1, nlo1, r_ehi, r_elo)
-            xf_hi, xf_lo = _sel64(is_greg, r_ghi, r_glo, xf_hi, xf_lo)
-            limit0 = _sel(fresh, r_lim, it_limit)
-            rem0 = _sel(fresh, r_lim, it_rem)
-            t_hi, t_lo = _sel64(fresh, nhi1, nlo1, it_thi, it_tlo)
-            x_hi, x_lo = _sel64(fresh, xf_hi, xf_lo, x1hi, x1lo)
-            status0 = _sel(fresh, i32(0), it_status)
-            e_hi, e_lo = _sel64(fresh | dur_change, r_ehi, r_elo,
-                                it_ehi, it_elo)
-
-            # RESET_REMAINING on existing items
-            reset_live = reset & (~fresh)
-            rem0 = _sel(reset_live, r_lim, rem0)
-            status0 = _sel(reset_live, i32(0), status0)
-            limit_ar = _sel(reset_live, r_lim, limit0)
-
-            # token limit change in place
-            lim_change = r_lim != limit_ar
-            rem_adj = jnp.clip(rem0 + r_lim - limit_ar, i32(0), r_lim)
-            rem0 = _sel(lim_change, rem_adj, rem0)
-
-            # hits
+            # fresh: empty / expired / algorithm switch
+            fresh0 = ((~found) | _ge64(nhi1, nlo1, it_xhi, it_xlo)
+                      | (it_alg != r_alg))
             is_query = r_hits == i32(0)
-            ok = r_hits <= rem0
-            rem2 = _sel((~is_query) & ok, rem0 - r_hits, rem0)
-            rem2 = _sel((~is_query) & (~ok) & drain, i32(0), rem2)
-            status1 = _sel(is_query, status0,
-                           _sel(ok, i32(0), i32(1)))
-
-            # write the slot back (unless the bucket was full)
-            @pl.when(~err)
-            def _writeback():
-                sel = slot1h[:, None]
-
-                def put(t, w, v):
-                    return jnp.where(sel & (lane == w), v, t)
-
-                nt = tile
-                nt = put(nt, W_KLO, klo)
-                nt = put(nt, W_KHI, khi)
-                nt = put(nt, W_REM, rem2)
-                nt = put(nt, W_STATUS, status1)
-                nt = put(nt, W_LIMIT, r_lim)
-                nt = put(nt, W_TLO, t_lo)
-                nt = put(nt, W_THI, t_hi)
-                nt = put(nt, W_XLO, x_lo)
-                nt = put(nt, W_XHI, x_hi)
-                nt = put(nt, W_ELO, e_lo)
-                nt = put(nt, W_EHI, e_hi)
-                nt = put(nt, W_DLO, r_dlo)
-                nt = put(nt, W_DHI, r_dhi)
-                scratch[pl.ds(base, SLOTS), :] = nt
-
-            # outputs (err rows zeroed, as the XLA step masks them)
             dead = err
-            st_o[0, 0, j] = _sel(dead, i32(0), status1)
-            rem_o[0, 0, j] = _sel(dead, i32(0), rem2)
-            rlo_o[0, 0, j] = _sel(dead, i32(0), x_lo)
-            rhi_o[0, 0, j] = _sel(dead, i32(0), x_hi)
-            lim_o[0, 0, j] = _sel(dead, i32(0), r_lim)
             flg_o[0, 0, j] = err.astype(i32) | (
                 (insert & ~err).astype(i32) << 1)
+            lim_o[0, 0, j] = _sel(dead, i32(0), r_lim)
+            # default-zero the branch-written outputs: a valid row with
+            # an out-of-domain algorithm (neither pl.when fires —
+            # callers must gate on pallas_qualifies, but defense here
+            # is one store) must return zeros, never uninitialized
+            # output memory
+            st_o[0, 0, j] = i32(0)
+            rem_o[0, 0, j] = i32(0)
+            rlo_o[0, 0, j] = i32(0)
+            rhi_o[0, 0, j] = i32(0)
+
+            @pl.when(r_alg == i32(0))
+            def _token():
+                fresh = fresh0
+                # token duration change → recompute expiry from item.t
+                dur_change = ((~fresh)
+                              & _neq64(r_dhi, r_dlo, it_dhi, it_dlo))
+                ne_hi, ne_lo = _add64(it_thi, it_tlo, r_ehi, r_elo)
+                ne_hi, ne_lo = _sel64(is_greg, r_ghi, r_glo, ne_hi,
+                                      ne_lo)
+                x1hi, x1lo = _sel64(dur_change, ne_hi, ne_lo,
+                                    it_xhi, it_xlo)
+                fresh = fresh | (dur_change
+                                 & ~_ge64(x1hi, x1lo, nhi1, nlo1)
+                                 ) | (dur_change & _ge64(nhi1, nlo1,
+                                                         x1hi, x1lo))
+                # (exp1 <= now  ≡  now >= exp1; the first disjunct is
+                # exp1 < now via !(exp1 >= now) — keep both for
+                # exactness with oracle's `exp1 <= now`)
+
+                # adopt fresh or existing
+                xf_hi, xf_lo = _add64(nhi1, nlo1, r_ehi, r_elo)
+                xf_hi, xf_lo = _sel64(is_greg, r_ghi, r_glo,
+                                      xf_hi, xf_lo)
+                limit0 = _sel(fresh, r_lim, it_limit)
+                rem0 = _sel(fresh, r_lim, it_rem)
+                t_hi, t_lo = _sel64(fresh, nhi1, nlo1, it_thi, it_tlo)
+                x_hi, x_lo = _sel64(fresh, xf_hi, xf_lo, x1hi, x1lo)
+                status0 = _sel(fresh, i32(0), it_status)
+                e_hi, e_lo = _sel64(fresh | dur_change, r_ehi, r_elo,
+                                    it_ehi, it_elo)
+
+                # RESET_REMAINING on existing items
+                reset_live = reset & (~fresh)
+                rem0 = _sel(reset_live, r_lim, rem0)
+                status0 = _sel(reset_live, i32(0), status0)
+                limit_ar = _sel(reset_live, r_lim, limit0)
+
+                # token limit change in place
+                lim_change = r_lim != limit_ar
+                rem_adj = jnp.clip(rem0 + r_lim - limit_ar, i32(0),
+                                   r_lim)
+                rem0 = _sel(lim_change, rem_adj, rem0)
+
+                # hits
+                ok = r_hits <= rem0
+                rem2 = _sel((~is_query) & ok, rem0 - r_hits, rem0)
+                rem2 = _sel((~is_query) & (~ok) & drain, i32(0), rem2)
+                status1 = _sel(is_query, status0,
+                               _sel(ok, i32(0), i32(1)))
+
+                # write the slot back (unless the bucket was full)
+                @pl.when(~err)
+                def _writeback():
+                    sel = slot1h[:, None]
+
+                    def put(t, w, v):
+                        return jnp.where(sel & (lane == w), v, t)
+
+                    nt = tile
+                    nt = put(nt, W_KLO, klo)
+                    nt = put(nt, W_KHI, khi)
+                    nt = put(nt, W_REM, rem2)
+                    nt = put(nt, W_STATUS, status1)
+                    nt = put(nt, W_LIMIT, r_lim)
+                    nt = put(nt, W_TLO, t_lo)
+                    nt = put(nt, W_THI, t_hi)
+                    nt = put(nt, W_XLO, x_lo)
+                    nt = put(nt, W_XHI, x_hi)
+                    nt = put(nt, W_ELO, e_lo)
+                    nt = put(nt, W_EHI, e_hi)
+                    nt = put(nt, W_DLO, r_dlo)
+                    nt = put(nt, W_DHI, r_dhi)
+                    nt = put(nt, W_ALG, i32(0))
+                    nt = put(nt, W_TDLO, i32(0))
+                    nt = put(nt, W_TDHI, i32(0))
+                    scratch[pl.ds(base, SLOTS), :] = nt
+
+                # outputs (err rows zeroed, as the XLA step masks them)
+                st_o[0, 0, j] = _sel(dead, i32(0), status1)
+                rem_o[0, 0, j] = _sel(dead, i32(0), rem2)
+                rlo_o[0, 0, j] = _sel(dead, i32(0), x_lo)
+                rhi_o[0, 0, j] = _sel(dead, i32(0), x_hi)
+
+            @pl.when(r_alg == i32(1))
+            def _leaky():
+                # request-only td columns (precomputed by the wrapper):
+                # hits×eff, burst×eff (cap), limit×eff (reset value),
+                # eff//limit (rate), TD_BOUND//limit (replenish guard)
+                r_htl, r_hth = htl_ref[0, 0, j], hth_ref[0, 0, j]
+                r_cpl, r_cph = cpl_ref[0, 0, j], cph_ref[0, 0, j]
+                r_rsl, r_rsh = rsl_ref[0, 0, j], rsh_ref[0, 0, j]
+                r_rate = rate_ref[0, 0, j]
+                r_gdl, r_gdh = gdl_ref[0, 0, j], gdh_ref[0, 0, j]
+
+                # denominator change → rescale the td fixed point to
+                # the new eff.  In the kernel domain both denominators
+                # are < EFF_BOUND ≤ FRAC_SAFE, so the sub-token
+                # fraction is ALWAYS kept, and whole < 2^30 ≤
+                # TD_BOUND//eff makes the oracle's whole-token clamp a
+                # no-op (see EFF_BOUND).  Divides run unconditionally
+                # (lane-selected away on ~eff_change); a token-item
+                # divisor (alg switch) feeds garbage that fresh0
+                # discards — _udiv64_32 is total, never faulting.
+                eff_change = ((~fresh0)
+                              & _neq64(r_ehi, r_elo, it_ehi, it_elo))
+                whole, fracr = _udiv64_32(it_tdhi, it_tdlo, it_elo)
+                fth, ftl = _umul32x32(fracr, r_elo)
+                frac_term, _ = _udiv64_32(fth, ftl, it_elo)
+                wh, wl = _umul32x32(whole, r_elo)
+                resc_h, resc_l = _add64(wh, wl, i32(0), frac_term)
+                td0h, td0l = _sel64(eff_change, resc_h, resc_l,
+                                    it_tdhi, it_tdlo)
+
+                # fresh adoption: bucket starts full (burst × eff)
+                td0h, td0l = _sel64(fresh0, r_cph, r_cpl, td0h, td0l)
+                status0 = _sel(fresh0, i32(0), it_status)
+                t0h, t0l = _sel64(fresh0, nhi1, nlo1, it_thi, it_tlo)
+
+                # RESET_REMAINING on existing items: limit × eff
+                reset_live = reset & (~fresh0)
+                td0h, td0l = _sel64(reset_live, r_rsh, r_rsl,
+                                    td0h, td0l)
+                status0 = _sel(reset_live, i32(0), status0)
+
+                # replenish: elapsed × limit td, clamped to cap.
+                # elapsed > TD_BOUND//limit ⇒ the true product already
+                # exceeds the cap — bucket simply full (exact, as in
+                # oracle.apply_leaky).  Fresh lanes: t0 = now ⇒
+                # elapsed = 0 ⇒ no-op, mirroring the XLA step.
+                elh, ell = _sub64(nhi1, nlo1, t0h, t0l)
+                over_g = ~_ge64(r_gdh, r_gdl, elh, ell)
+                ech, ecl = _sel64(over_g, r_gdh, r_gdl, elh, ell)
+                adh, adl = _umul64x32(ech, ecl, r_lim)
+                sh, sl = _add64(td0h, td0l, adh, adl)
+                full = over_g | _ge64(sh, sl, r_cph, r_cpl)
+                rph, rpl = _sel64(full, r_cph, r_cpl, sh, sl)
+
+                # hits (cost = hits × eff, precomputed)
+                ok = _ge64(rph, rpl, r_hth, r_htl)
+                d2h, d2l = _sub64(rph, rpl, r_hth, r_htl)
+                apply_ok = (~is_query) & ok
+                td2h, td2l = _sel64(apply_ok, d2h, d2l, rph, rpl)
+                drain_hit = (~is_query) & (~ok) & drain
+                td2h, td2l = _sel64(drain_hit, i32(0), i32(0),
+                                    td2h, td2l)
+                status1 = _sel(is_query, status0,
+                               _sel(ok, i32(0), i32(1)))
+
+                # response: remaining in whole tokens, reset_time =
+                # now + eff//limit (NOT the stored expire = now + eff)
+                rem_out, _ = _udiv64_32(td2h, td2l, r_elo)
+                x_hi, x_lo = _add64(nhi1, nlo1, r_ehi, r_elo)
+                rsh_, rsl_ = _add64(nhi1, nlo1, i32(0), r_rate)
+
+                @pl.when(~err)
+                def _writeback():
+                    sel = slot1h[:, None]
+
+                    def put(t, w, v):
+                        return jnp.where(sel & (lane == w), v, t)
+
+                    nt = tile
+                    nt = put(nt, W_KLO, klo)
+                    nt = put(nt, W_KHI, khi)
+                    nt = put(nt, W_REM, i32(0))
+                    nt = put(nt, W_STATUS, status1)
+                    nt = put(nt, W_LIMIT, r_lim)
+                    nt = put(nt, W_TLO, nlo1)
+                    nt = put(nt, W_THI, nhi1)
+                    nt = put(nt, W_XLO, x_lo)
+                    nt = put(nt, W_XHI, x_hi)
+                    nt = put(nt, W_ELO, r_elo)
+                    nt = put(nt, W_EHI, r_ehi)
+                    nt = put(nt, W_DLO, r_dlo)
+                    nt = put(nt, W_DHI, r_dhi)
+                    nt = put(nt, W_ALG, i32(1))
+                    nt = put(nt, W_TDLO, td2l)
+                    nt = put(nt, W_TDHI, td2h)
+                    scratch[pl.ds(base, SLOTS), :] = nt
+
+                st_o[0, 0, j] = _sel(dead, i32(0), status1)
+                rem_o[0, 0, j] = _sel(dead, i32(0), rem_out)
+                rlo_o[0, 0, j] = _sel(dead, i32(0), rsl_)
+                rhi_o[0, 0, j] = _sel(dead, i32(0), rsh_)
 
         @pl.when(~valid)
         def _invalid():
@@ -410,8 +638,11 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
     lax.fori_loop(0, TILE, wait_out, 0)
 
 
+N_COLS = 26  # SMEM request columns (see _kernel signature order)
+
+
 def _call_kernel(rows, cols, interpret: bool):
-    """cols: 16 int32 arrays shaped [G, 1, TILE] (see _kernel order).
+    """cols: N_COLS int32 arrays shaped [G, 1, TILE] (_kernel order).
 
     The singleton middle axis is load-bearing on real Mosaic: a block's
     last two dims must be divisible by (8, 128) or equal the array's —
@@ -429,11 +660,11 @@ def _call_kernel(rows, cols, interpret: bool):
         return pl.pallas_call(
             _kernel,
             grid=(G,),
-            in_specs=[smem_tile] * 16 + [table_spec],
+            in_specs=[smem_tile] * N_COLS + [table_spec],
             out_specs=[table_spec] + [out_tile] * 6,
             out_shape=[jax.ShapeDtypeStruct(rows.shape, jnp.int32)]
             + [o32] * 6,
-            input_output_aliases={16: 0},
+            input_output_aliases={N_COLS: 0},
             scratch_shapes=[
                 pltpu.VMEM((TILE * SLOTS, WORDS), jnp.int32),
                 pltpu.SemaphoreType.DMA((TILE,)),
@@ -447,7 +678,7 @@ def _call_kernel(rows, cols, interpret: bool):
 def decide_batch_pallas(table: PallasTable, batch: RequestBatch, now_ms,
                         *, interpret: bool = False
                         ) -> tuple[PallasTable, StepOutput]:
-    """Apply one TOKEN_BUCKET batch to the Pallas table.
+    """Apply one decision batch (TOKEN or LEAKY rows) to the table.
 
     Same contract as core/step.py › decide_batch for batches inside
     the kernel's domain (``pallas_qualifies``) — the parity tests
@@ -481,6 +712,22 @@ def decide_batch_pallas(table: PallasTable, batch: RequestBatch, now_ms,
     ghi, glo = _split64(batch.greg_end.astype(i64))
     nhi, nlo = _split64(now_col)
 
+    # Request-only leaky td products, in REAL int64 before the i32
+    # split (eff masked to 1 on token rows so huge token hits/limits
+    # can't wrap the unused product — same operand masking as
+    # core/step.py's eff_l).
+    alg = batch.algorithm.astype(i32)
+    is_lk = alg == 1
+    eff64 = batch.eff_ms.astype(i64)
+    lim64 = batch.limit.astype(i64)
+    eff_l = jnp.where(is_lk, eff64, 1)
+    hth, htl = _split64(batch.hits.astype(i64) * eff_l)
+    cph, cpl = _split64(batch.burst.astype(i64) * eff_l)
+    rsh, rsl = _split64(lim64 * eff_l)
+    rate = jnp.where(lim64 > 0, eff_l // jnp.maximum(lim64, 1),
+                     eff_l).astype(i32)
+    gdh, gdl = _split64(TD_BOUND // jnp.maximum(lim64, 1))
+
     bb = pad_to(bucket)
     cols1d = [
         bb,
@@ -488,6 +735,7 @@ def decide_batch_pallas(table: PallasTable, batch: RequestBatch, now_ms,
         batch.hits.astype(i32), batch.limit.astype(i32),
         dlo, dhi, elo, ehi, glo, ghi,
         batch.behavior.astype(i32), nlo, nhi, valid,
+        alg, htl, hth, cpl, cph, rsl, rsh, rate, gdl, gdh,
     ]
     cols1d = [bb] + [pad_to(c) for c in cols1d[1:]]
 

@@ -14,7 +14,7 @@ import pytest
 from gubernator_tpu.core.batch import RequestBatch
 from gubernator_tpu.core.step import decide_batch
 from gubernator_tpu.core.table import init_table
-from gubernator_tpu.ops.pallas_step import (SLOTS, VALUE_BOUND,
+from gubernator_tpu.ops.pallas_step import (EFF_BOUND, SLOTS, VALUE_BOUND,
                                             decide_batch_pallas,
                                             init_pallas_table,
                                             pallas_qualifies)
@@ -217,12 +217,172 @@ class TestPallasStepParity:
         run_both(batches, nows)
 
 
+def mk_leaky(keys, **over):
+    base = dict(algorithm=jnp.ones(len(keys), i32),
+                limit=jnp.full(len(keys), 10, i64),
+                burst=jnp.full(len(keys), 10, i64),
+                duration=jnp.full(len(keys), 10_000, i64),
+                eff_ms=jnp.full(len(keys), 10_000, i64))
+    base.update(over)
+    return mk_batch(keys, **base)
+
+
+class TestPallasLeakyParity:
+    """LEAKY_BUCKET parity: the kernel's paired-i32 td fixed point
+    (in-kernel 64÷32 restoring division + 32×32→64 multiplies) vs the
+    XLA step's native int64 arithmetic — every decision field, every
+    wave (mirrors oracle.apply_leaky through test_step_parity's
+    XLA-vs-oracle contract)."""
+
+    def test_drain_and_replenish_over_time(self):
+        keys = keyify(np.arange(48))
+        n = 48
+        batches, nows = [], []
+        # drain 3/step at rate limit=10 per 10s → leak 1 token/s
+        for w in range(8):
+            batches.append(mk_leaky(keys, hits=jnp.full(n, 3, i64)))
+            nows.append(NOW + w * 700)  # partial-token replenish steps
+        run_both(batches, nows)
+
+    def test_burst_differs_from_limit(self):
+        keys = keyify(np.arange(32))
+        b_hi = mk_leaky(keys, burst=jnp.full(32, 25, i64),
+                        hits=jnp.full(32, 4, i64))
+        b_lo = mk_leaky(keys, burst=jnp.full(32, 3, i64),
+                        hits=jnp.full(32, 2, i64))
+        run_both([b_hi, b_hi, b_hi], [NOW, NOW + 100, NOW + 5_000])
+        run_both([b_lo, b_lo], [NOW, NOW + 30_000])
+
+    def test_queries_and_flags(self):
+        rng = np.random.default_rng(5)
+        keys = keyify(rng.integers(0, 24, size=192))
+        beh = np.zeros(192, np.int32)
+        beh[::5] = int(Behavior.RESET_REMAINING)
+        beh[2::7] = int(Behavior.DRAIN_OVER_LIMIT)
+        hits = rng.integers(0, 5, size=192)  # queries included
+        batches = [mk_leaky(keys, hits=jnp.asarray(hits, i64),
+                            behavior=jnp.asarray(beh))
+                   for _ in range(4)]
+        run_both(batches, [NOW, NOW + 400, NOW + 900, NOW + 12_000])
+
+    def test_eff_change_rescales_td(self):
+        keys = keyify(np.arange(40))
+        b1 = mk_leaky(keys, hits=jnp.full(40, 4, i64))
+        # same window, new denominator: td rescales, fraction kept
+        b2 = mk_leaky(keys, duration=jnp.full(40, 60_000, i64),
+                      eff_ms=jnp.full(40, 60_000, i64))
+        # back down mid-window
+        b3 = mk_leaky(keys, duration=jnp.full(40, 7_000, i64),
+                      eff_ms=jnp.full(40, 7_000, i64),
+                      hits=jnp.full(40, 2, i64))
+        run_both([b1, b2, b3], [NOW, NOW + 333, NOW + 666])
+
+    def test_limit_change_and_alg_switch(self):
+        keys = keyify(np.arange(24))
+        lk = mk_leaky(keys, hits=jnp.full(24, 5, i64))
+        lk2 = mk_leaky(keys, limit=jnp.full(24, 30, i64),
+                       burst=jnp.full(24, 30, i64))
+        tok = mk_batch(keys, hits=jnp.full(24, 2, i64))
+        # leaky → leaky(limit change) → TOKEN (alg switch = fresh)
+        # → back to leaky (fresh again)
+        run_both([lk, lk2, tok, lk],
+                 [NOW, NOW + 50, NOW + 100, NOW + 150])
+
+    def test_mixed_token_and_leaky_rows_one_batch(self):
+        rng = np.random.default_rng(9)
+        n = 256
+        ids = rng.integers(0, 40, size=n)
+        alg = (ids % 2).astype(np.int32)  # per-key algorithm (stable)
+        b = mk_batch(keyify(ids), algorithm=jnp.asarray(alg),
+                     hits=jnp.asarray(rng.integers(0, 4, size=n), i64),
+                     burst=jnp.full(n, 10, i64))
+        run_both([b, b], [NOW, NOW + 800])
+
+    def test_gregorian_leaky_rate(self):
+        """DURATION_IS_GREGORIAN leaky: eff is the fixed-width rate
+        duration (precomputed eff_ms column), expiry = now + eff."""
+        from gubernator_tpu.gregorian import gregorian_rate_duration_ms
+        from gubernator_tpu.types import GregorianDuration
+
+        eff = gregorian_rate_duration_ms(int(GregorianDuration.HOURS))
+        keys = keyify(np.arange(16))
+        beh = np.full(16, int(Behavior.DURATION_IS_GREGORIAN), np.int32)
+        b = mk_leaky(keys, behavior=jnp.asarray(beh),
+                     duration=jnp.full(16, int(GregorianDuration.HOURS),
+                                       i64),
+                     eff_ms=jnp.full(16, eff, i64),
+                     greg_end=jnp.full(16, NOW + 3_600_000, i64),
+                     hits=jnp.full(16, 2, i64))
+        run_both([b, b], [NOW, NOW + 60_000])
+
+    def test_td_bounds_stress_carry_paths(self):
+        """Counters and eff at the domain edge: td products near 2^61
+        drive carries through every paired-i32 primitive (mul halves,
+        add/sub borrows, 32-step division with sign-wrapped words)."""
+        big_v = VALUE_BOUND - 1       # 2^30 - 1
+        big_e = EFF_BOUND - 1         # 2^31 - 1
+        keys = keyify(np.arange(12))
+        b = mk_leaky(keys, limit=jnp.full(12, big_v, i64),
+                     burst=jnp.full(12, big_v, i64),
+                     duration=jnp.full(12, big_e, i64),
+                     eff_ms=jnp.full(12, big_e, i64),
+                     hits=jnp.full(12, big_v // 2, i64))
+        # second wave replenishes with a large elapsed × limit product
+        run_both([b, b, b], [NOW, NOW + 1_000_000, NOW + big_e + 5])
+        # odd eff/hits mixes: division remainders on every lane
+        b2 = mk_leaky(keys, limit=jnp.full(12, 999_983, i64),
+                      burst=jnp.full(12, 1_000_003, i64),
+                      duration=jnp.full(12, 2_147_483_629, i64),
+                      eff_ms=jnp.full(12, 2_147_483_629, i64),
+                      hits=jnp.full(12, 7, i64))
+        run_both([b2, b2], [NOW, NOW + 777_777])
+
+    def test_leaky_bucket_full_errors(self):
+        """Overflowing bucket: leaky rows err like token rows."""
+        keys = np.array([(j << 40) | 9 for j in range(1, SLOTS + 3)],
+                        np.uint64)
+        b = mk_leaky(keys)
+        pt, po = decide_batch_pallas(init_pallas_table(256), b,
+                                     jnp.asarray(NOW, i64),
+                                     interpret=True)
+        err = np.asarray(po.err)
+        assert err.sum() == 2
+        assert (np.asarray(po.remaining)[~err] == 9).all()
+
+    def test_sustained_mixed_stream(self):
+        """10 waves of mixed token/leaky traffic with churn on every
+        axis the kernel branches on."""
+        rng = np.random.default_rng(11)
+        batches, nows = [], []
+        t = NOW
+        for w in range(10):
+            n = 256
+            ids = rng.zipf(1.2, size=n) % 60
+            alg = (ids % 2).astype(np.int32)
+            beh = np.where(rng.random(n) < 0.06,
+                           int(Behavior.RESET_REMAINING), 0)
+            beh = np.where(rng.random(n) < 0.06,
+                           beh | int(Behavior.DRAIN_OVER_LIMIT), beh)
+            dur = np.where(ids % 5 == 0, 25_000, 10_000).astype(np.int64)
+            batches.append(mk_batch(
+                keyify(ids), algorithm=jnp.asarray(alg),
+                hits=jnp.asarray(rng.integers(0, 5, size=n), i64),
+                limit=jnp.full(n, 10 + (w % 4) * 7, i64),
+                burst=jnp.full(n, 10 + (w % 4) * 7, i64),
+                duration=jnp.asarray(dur),
+                eff_ms=jnp.asarray(dur),
+                behavior=jnp.asarray(beh.astype(np.int32))))
+            t += int(rng.integers(0, 9_000))
+            nows.append(t)
+        run_both(batches, nows)
+
+
 class TestPropertyParity:
-    """Hypothesis fuzz: ANY token stream inside the kernel's domain
-    must match the XLA step exactly (same pattern as
+    """Hypothesis fuzz: ANY token/leaky stream inside the kernel's
+    domain must match the XLA step exactly (same pattern as
     test_property_parity.py, scaled by GUBER_FUZZ_X)."""
 
-    def test_any_token_stream_matches_xla(self):
+    def test_any_stream_matches_xla(self):
         import os as _os
 
         from hypothesis import HealthCheck, given, settings
@@ -240,6 +400,9 @@ class TestPropertyParity:
             st.integers(0, 30),     # limit
             st.integers(1, 50_000),  # duration
             _beh,
+            st.integers(0, 1),      # algorithm (token/leaky)
+            st.integers(0, 35),     # burst (leaky; 0 → limit upstream,
+                                    # here passed through as-is)
         )
         _stream = st.lists(
             st.tuples(st.lists(_row, min_size=1, max_size=32),
@@ -273,6 +436,11 @@ class TestPropertyParity:
                         constant_values=1), i64),
                     behavior=jnp.asarray(np.pad(
                         [r[4] for r in rows], (0, pad)).astype(np.int32)),
+                    algorithm=jnp.asarray(np.pad(
+                        [r[5] for r in rows], (0, pad)).astype(np.int32)),
+                    burst=jnp.asarray(np.pad(
+                        [max(r[6], 1) for r in rows], (0, pad),
+                        constant_values=1), i64),
                     valid=jnp.asarray(
                         np.arange(B) < n))
                 assert pallas_qualifies(b)
@@ -289,20 +457,35 @@ class TestPropertyParity:
 
 
 class TestQualifier:
-    def test_rejects_leaky_and_big_values(self):
+    def test_domain_bounds(self):
         keys = keyify(np.arange(8))
         assert pallas_qualifies(mk_batch(keys))
-        assert not pallas_qualifies(
+        # leaky now qualifies (round-4 kernel extension) …
+        assert pallas_qualifies(
             mk_batch(keys, algorithm=jnp.ones(8, i32)))
+        # … but unknown algorithm values do not
+        assert not pallas_qualifies(
+            mk_batch(keys, algorithm=jnp.full(8, 2, i32)))
         assert not pallas_qualifies(
             mk_batch(keys, limit=jnp.full(8, VALUE_BOUND, i64)))
         assert not pallas_qualifies(
             mk_batch(keys, hits=jnp.full(8, -1, i64)))
+        # leaky eff must fit the one-word divisor bound
+        assert not pallas_qualifies(
+            mk_batch(keys, algorithm=jnp.ones(8, i32),
+                     eff_ms=jnp.full(8, EFF_BOUND, i64)))
+        assert not pallas_qualifies(
+            mk_batch(keys, algorithm=jnp.ones(8, i32),
+                     eff_ms=jnp.zeros(8, i64)))
+        # a token row with huge eff is fine (eff is not divided there)
+        assert pallas_qualifies(
+            mk_batch(keys, eff_ms=jnp.full(8, EFF_BOUND * 16, i64),
+                     duration=jnp.full(8, EFF_BOUND * 16, i64)))
         # invalid rows don't disqualify (they're masked anyway)
-        leaky_invalid = mk_batch(
-            keys, algorithm=jnp.ones(8, i32),
+        bad_invalid = mk_batch(
+            keys, algorithm=jnp.full(8, 2, i32),
             valid=jnp.zeros(8, bool))
-        assert pallas_qualifies(leaky_invalid)
+        assert pallas_qualifies(bad_invalid)
 
     def test_rejects_time_inverted_duplicates(self):
         """Same key with DECREASING now in batch order serializes

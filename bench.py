@@ -1409,17 +1409,28 @@ def _watchdog_main():
     print(out)
 
 
+#: operator hold-off sentinel: repo-local by default (a fixed world-
+#: writable /tmp path could be planted by any local user or survive
+#: stale from a prior session and silently skip every future bench);
+#: GUBER_BENCH_SKIP_FILE overrides for operators who need another path
+_SKIP_SENTINEL = os.environ.get(
+    "GUBER_BENCH_SKIP_FILE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "artifacts", "BENCH_SKIP"))
+
 if __name__ == "__main__":
     # operator hold-off: lets a supervising session stop an already-
     # launched benchmark (or its watchdog/section children — each one
     # re-enters here) from starting device work.  The battery spawns
     # bench.py as a child long after launch; killing that child mid-
     # compile is the known tunnel-wedge mechanism, a sentinel is safe.
-    if os.path.exists("/tmp/GUBER_BENCH_SKIP"):
+    if os.path.exists(_SKIP_SENTINEL):
+        log(f"SKIPPED: operator hold-off sentinel present at "
+            f"{_SKIP_SENTINEL} — remove it to re-enable benching")
         print(json.dumps({"metric": "skipped", "value": 0, "unit": "",
                           "vs_baseline": 0.0,
                           "extra": {"skipped":
-                                    "/tmp/GUBER_BENCH_SKIP present"}}))
+                                    f"{_SKIP_SENTINEL} present"}}))
     elif os.environ.get("GUBER_BENCH_SECTION"):
         _section_main()
     elif os.environ.get("GUBER_BENCH_INNER"):

@@ -51,25 +51,39 @@ _GREG = int(Behavior.DURATION_IS_GREGORIAN)
 _I64_MAX = jnp.iinfo(jnp.int64).max
 
 #: test hook (tests/test_scatter_invariants.py): when True at TRACE
-#: time, every step asserts the writeback index vector really is
-#: strictly ascending + unique — the promises the scatters below make
-#: to the backend (unique_indices / indices_are_sorted are UB if lied
-#: about, and a CPU parity run would not catch the lie).
+#: time, every step asserts EVERY index vector a scatter makes promises
+#: about really satisfies them — wrow must be strictly ascending +
+#: unique (unique_indices + indices_are_sorted), _insert's tkey claim
+#: vector and body_fn's idxj must be all-distinct (unique_indices).
+#: The promises are UB if lied about, and a CPU parity run would not
+#: catch the lie.
 _CHECK_SCATTER_INVARIANTS = False
 _SCATTER_INVARIANT_VIOLATIONS: list = []
 
 
-_SCATTER_INVARIANT_CHECKS = [0]  # fire counter: a hook that never ran
-# would make the invariant test pass vacuously
+#: per-site fire counters: a hook that never ran would make the
+#: invariant test pass vacuously
+_SCATTER_INVARIANT_CHECKS = {"wrow": 0, "insert_tkey": 0,
+                             "body_idxj": 0}
 
 
 def _record_wrow(wrow_np):
     import numpy as np
 
-    _SCATTER_INVARIANT_CHECKS[0] += 1
+    _SCATTER_INVARIANT_CHECKS["wrow"] += 1
     w = np.asarray(wrow_np)
     if not (np.diff(w.astype(np.int64)) > 0).all():
-        _SCATTER_INVARIANT_VIOLATIONS.append(w.copy())
+        _SCATTER_INVARIANT_VIOLATIONS.append(("wrow", w.copy()))
+
+
+def _record_unique(label, idx_np):
+    """unique_indices-only promise sites (no sortedness claimed)."""
+    import numpy as np
+
+    _SCATTER_INVARIANT_CHECKS[label] += 1
+    w = np.asarray(idx_np)
+    if np.unique(w).size != w.size:
+        _SCATTER_INVARIANT_VIOLATIONS.append((label, w.copy()))
 
 
 class StepOutput(NamedTuple):
@@ -170,9 +184,11 @@ def _insert(tkey: jax.Array, slots: jax.Array, key: jax.Array,
         # per step at CAP >= 2^22 vs 0.118 ms at 2^21)
         winner = jnp.zeros(B, bool).at[order].set(first,
                                                   unique_indices=True)
-        tkey = tkey.at[
-            jnp.where(winner, cand, cap + jnp.arange(B, dtype=cand.dtype))
-        ].set(key, mode="drop", unique_indices=True)
+        claim = jnp.where(winner, cand,
+                          cap + jnp.arange(B, dtype=cand.dtype))
+        if _CHECK_SCATTER_INVARIANTS:  # trace-time test hook
+            jax.debug.callback(_record_unique, "insert_tkey", claim)
+        tkey = tkey.at[claim].set(key, mode="drop", unique_indices=True)
         row = jnp.where(winner, cand, row)
         n_claimed = n_claimed + winner.sum(dtype=jnp.int64)
 
@@ -591,6 +607,8 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
         # as the table writeback below
         idxj = jnp.where(m, seg_start + j,
                          B + jnp.arange(B, dtype=i32)).astype(i32)
+        if _CHECK_SCATTER_INVARIANTS:  # trace-time test hook
+            jax.debug.callback(_record_unique, "body_idxj", idxj)
         reqj = _Req(*[x.at[idxj].get(mode="fill", fill_value=0) for x in sf])
         item2, outj = _apply_position(item, reqj)
         item = _tree_where(m, item2, item)

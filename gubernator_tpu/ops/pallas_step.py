@@ -147,6 +147,7 @@ def _tsum8(v):
     re-traces under the ambient x64 config and emits 64-bit converts
     that have no TPU lowering (observed on-chip 2026-08-01); elementwise
     adds + a final scalar extract lower natively."""
+    assert SLOTS == 8, "halving trees are hardcoded to 8-slot buckets"
     m = v[:4] + v[4:]
     m = m[:2] + m[2:]
     return m[0] + m[1]
@@ -154,6 +155,7 @@ def _tsum8(v):
 
 def _tmin8(v):
     """(8,) i32 → scalar min via a halving tree (see _tsum8)."""
+    assert SLOTS == 8, "halving trees are hardcoded to 8-slot buckets"
     m = jnp.minimum(v[:4], v[4:])
     m = jnp.minimum(m[:2], m[2:])
     return jnp.minimum(m[0], m[1])

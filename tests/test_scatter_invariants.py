@@ -48,7 +48,8 @@ def invariant_hook():
     jax.clear_caches()  # cached traces predate the hook
     step_mod._CHECK_SCATTER_INVARIANTS = True
     step_mod._SCATTER_INVARIANT_VIOLATIONS.clear()
-    step_mod._SCATTER_INVARIANT_CHECKS[0] = 0
+    for k in step_mod._SCATTER_INVARIANT_CHECKS:
+        step_mod._SCATTER_INVARIANT_CHECKS[k] = 0
     yield step_mod._SCATTER_INVARIANT_VIOLATIONS
     step_mod._CHECK_SCATTER_INVARIANTS = False
     jax.clear_caches()
@@ -72,12 +73,29 @@ def test_wrow_strictly_ascending_under_adversarial_batches(invariant_hook):
     valid = rng.random(128) > 0.2
     st, out = decide_batch(st, _mk(keys, now_col=nows, valid=valid),
                            jnp.asarray(NOW + 20, i64))
+    # complex tails (duplicate keys + per-request flags) drive the
+    # while_loop body whose idxj scatter also promises unique_indices
+    keys_c = np.repeat(rng.integers(1, 9, size=16), 8).astype(np.uint64)
+    bc = _mk(keys_c)
+    from gubernator_tpu.types import Behavior
+    beh = np.zeros(128, np.int32)
+    # RESET_REMAINING on some duplicates → segment not simple
+    beh[::3] = int(Behavior.RESET_REMAINING)
+    bc = bc._replace(behavior=jnp.asarray(beh))
+    st, out = decide_batch(st, bc, jnp.asarray(NOW + 30, i64))
     jax.block_until_ready(out.status)
     jax.effects_barrier()  # debug.callback effects are NOT flushed by
     # block_until_ready on async backends
 
-    assert step_mod._SCATTER_INVARIANT_CHECKS[0] >= 8, (
-        "the trace-time hook never fired — the test is vacuous")
+    counts = step_mod._SCATTER_INVARIANT_CHECKS
+    assert counts["wrow"] >= 8, (
+        "the wrow trace-time hook never fired — the test is vacuous")
+    # _insert runs INSERT_ROUNDS claim scatters per step; body_fn fires
+    # whenever a complex tail iterates (the mixed-now batch above).
+    # Every unique_indices promise site must have been exercised, or
+    # this test silently stops covering it (ADVICE r3 item 2).
+    assert counts["insert_tkey"] >= 8, counts
+    assert counts["body_idxj"] >= 1, counts
     assert not invariant_hook, (
-        f"{len(invariant_hook)} wrow vectors broke the scatter promises; "
-        f"first: {invariant_hook[0] if invariant_hook else None}")
+        f"{len(invariant_hook)} index vectors broke the scatter "
+        f"promises; first: {invariant_hook[0] if invariant_hook else None}")

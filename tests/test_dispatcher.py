@@ -189,20 +189,27 @@ def test_dispatcher_merges_packed_jobs_across_nows(pipeline, monkeypatch):
                             np.zeros(4, np.int64), now)
         return b, kh
 
-    # first job blocks the dispatcher inside the engine call; the other
-    # two queue up behind it and must merge into ONE later launch
-    threads = []
-    for t in range(3):
-        b, kh = cols(NOW + t)
+    # Force the queue path for every caller (the idle-inline fast path
+    # would otherwise run job 1 in its caller's thread and leave the
+    # worker free to drain jobs 2/3 early): with _inline_mu held, the
+    # first job blocks the WORKER inside the engine call and the other
+    # two queue up behind it, merging into ONE later launch.
+    disp._inline_mu.acquire()
+    try:
+        threads = []
+        for t in range(3):
+            b, kh = cols(NOW + t)
 
-        def call(b=b, kh=kh, t=t):
-            disp.check_packed(b, kh, NOW + t)
+            def call(b=b, kh=kh, t=t):
+                disp.check_packed(b, kh, NOW + t)
 
-        th = threading.Thread(target=call)
-        th.start()
-        threads.append(th)
-        if t == 0:
-            assert entered.wait(timeout=30)
+            th = threading.Thread(target=call)
+            th.start()
+            threads.append(th)
+            if t == 0:
+                assert entered.wait(timeout=30)
+    finally:
+        disp._inline_mu.release()
     deadline = time.monotonic() + 30
     while disp._queue.qsize() < 2 and time.monotonic() < deadline:
         time.sleep(0.01)
@@ -268,32 +275,40 @@ def test_mixed_wave_cross_now_merges_list_and_packed_jobs():
         return b, kh
 
     results = {}
-    # job 0 blocks the dispatcher inside the engine; the rest queue up
-    threads = [threading.Thread(
-        target=lambda: results.setdefault(
-            "blocker", disp.check_batch(reqs(0), NOW)))]
-    threads[0].start()
-    assert entered.wait(timeout=30)  # dispatcher is held in the engine
-    threads.append(threading.Thread(
-        target=lambda: results.setdefault(
-            "list1", disp.check_batch(reqs(1), NOW + 1))))
-    threads.append(threading.Thread(
-        target=lambda: results.setdefault(
-            "list2", disp.check_batch(reqs(2), NOW + 2))))
-    b, kh = packed_cols(NOW + 3)
-    threads.append(threading.Thread(
-        target=lambda: results.setdefault(
-            "packed", disp.check_packed(b, kh, NOW + 3))))
-    for t in threads[1:]:
-        t.start()
-    # deterministic: all three jobs must be IN the queue before release
-    import time as _t
+    # Force the queue path for ALL callers (see the inline-fast-path
+    # note in the merge test above): job 0 blocks the WORKER inside the
+    # engine; the rest queue up behind it.  _inline_mu stays held until
+    # every job is IN the queue — the try starts immediately so any
+    # assert in the setup still releases the mutex and the blocker.
+    disp._inline_mu.acquire()
+    try:
+        threads = [threading.Thread(
+            target=lambda: results.setdefault(
+                "blocker", disp.check_batch(reqs(0), NOW)))]
+        threads[0].start()
+        assert entered.wait(timeout=30)  # worker is held in the engine
+        threads.append(threading.Thread(
+            target=lambda: results.setdefault(
+                "list1", disp.check_batch(reqs(1), NOW + 1))))
+        threads.append(threading.Thread(
+            target=lambda: results.setdefault(
+                "list2", disp.check_batch(reqs(2), NOW + 2))))
+        b, kh = packed_cols(NOW + 3)
+        threads.append(threading.Thread(
+            target=lambda: results.setdefault(
+                "packed", disp.check_packed(b, kh, NOW + 3))))
+        for t in threads[1:]:
+            t.start()
+        # deterministic: all three jobs must be IN the queue pre-release
+        import time as _t
 
-    deadline = _t.monotonic() + 30
-    while disp._queue.qsize() < 3 and _t.monotonic() < deadline:
-        _t.sleep(0.01)
-    assert disp._queue.qsize() >= 3
-    release.set()
+        deadline = _t.monotonic() + 30
+        while disp._queue.qsize() < 3 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        assert disp._queue.qsize() >= 3
+    finally:
+        disp._inline_mu.release()
+        release.set()
     for t in threads:
         t.join(timeout=60)
     # blocker launched alone (it held the dispatcher while the rest

@@ -40,12 +40,30 @@ def log(*a):
 
 
 FAST = bool(os.environ.get("GUBER_BENCH_FAST"))
-#: north star is 10M keys; CAP 2^24 = load factor ~0.6.  The CPU
-#: fallback (GUBER_BENCH_FAST) shrinks the workload — its config string
-#: says so; it never silently stands in for the 10M-key number.
+#: north star is 10M keys; CAP 2^25 (load 0.30) + a 16-slot probe
+#: window is the empirically-verified zero-loss flagship shape: the
+#: EXACT 10M-key populate inserts every key (0 errs; at the former
+#: CAP 2^24/8-probe shape 17,739 keys lost every claim round and
+#: ~4e-4 of steady-state requests were unservable — VERDICT r3
+#: item 9).  The CPU fallback (GUBER_BENCH_FAST) shrinks the workload
+#: — its config string says so; it never silently stands in for the
+#: 10M-key number.
 N_KEYS = int(os.environ.get("GUBER_BENCH_KEYS",
                             1_000_000 if FAST else 10_000_000))
-CAP = int(os.environ.get("GUBER_BENCH_CAP", 1 << 21 if FAST else 1 << 24))
+CAP = int(os.environ.get("GUBER_BENCH_CAP", 1 << 21 if FAST else 1 << 25))
+#: widen the probe window for the flagship shape only: sections and
+#: the FAST fallback model general serving at the default window
+#: (engines auto-grow their load down, so 8 probes lose nothing
+#: there).  Must be set before gubernator_tpu.core.step is imported.
+#: The marker env distinguishes "bench defaulted this" from "operator
+#: set this" across the watchdog → inner-bench → section process tree
+#: (a bare not-in-environ check would mistake the inherited default
+#: for an operator choice one process down).
+_PROBES_DEFAULTED = ("GUBER_PROBES" not in os.environ
+                     or bool(os.environ.get("GUBER_PROBES_BENCH_DEFAULT")))
+if not FAST and _PROBES_DEFAULTED:
+    os.environ["GUBER_PROBES"] = "16"
+    os.environ["GUBER_PROBES_BENCH_DEFAULT"] = "1"
 #: device batch = coalesced client batches of 1024 (GUBER_BENCH_B
 #: overrides for batch-size sweeps)
 B = int(os.environ.get("GUBER_BENCH_B", 8192 if FAST else 65536))
@@ -129,19 +147,31 @@ def main():
     _bump1(jnp.asarray(0, i64)).block_until_ready()  # compile now, not
     # inside any timed region below
 
-    def populate(step_fn, st):
+    populate_errs = {}
+
+    def populate(step_fn, st, label):
         """Insert ALL N_KEYS distinct keys so the measured loop runs at
         the claimed working set (load factor N_KEYS/CAP), not at the few
         hundred thousand distinct keys a handful of Zipf draws covers —
         the sustained number must be the steady-state resident-table
-        rate it claims to be."""
+        rate it claims to be.  Insert failures are COUNTED and reported
+        (extra.populate_errs): the flagship claim is that the shape
+        serves 100% of its working set, and a key that lost every claim
+        round errs on every future request."""
         ids = np.arange(N_KEYS, dtype=np.uint64)
         now_pop = jnp.asarray(NOW0, i64)
+        errs = 0
         for a in range(0, N_KEYS, B):
             chunk = pad_chunk(ids[a:a + B], B)
             st, out = step_fn(st, make_batch(jnp.asarray(_keyhash(chunk))),
                               now_pop)
+            errs += int(np.asarray(out.err).sum())
         out.status.block_until_ready()
+        populate_errs[label] = errs
+        if errs:
+            log(f"[{label}] WARNING: {errs} keys failed to insert "
+                f"during populate — the rate below does not serve "
+                f"100% of the working set")
         return st
 
     def measure_mode(step_fn, label, sustain_target=15_000_000,
@@ -156,7 +186,7 @@ def main():
         log(f"[{label}] compile+first step in "
             f"{time.perf_counter() - t0:.1f}s")
         t0 = time.perf_counter()
-        st = populate(step_fn, st)
+        st = populate(step_fn, st, label)
         log(f"[{label}] populated {N_KEYS} keys "
             f"(load {N_KEYS/CAP:.2f}) in {time.perf_counter() - t0:.1f}s")
         now_dev = jnp.asarray(NOW0, i64)
@@ -268,7 +298,11 @@ def main():
                                   else None),
             "device_batch": B,
             "backend": backend,
-            "config": f"TOKEN_BUCKET {N_KEYS} keys Zipf({ZIPF_A}) hits=1 CAP={CAP}",
+            "populate_errs": dict(populate_errs),
+            "probes": int(os.environ.get("GUBER_PROBES", "8")),
+            "config": (f"TOKEN_BUCKET {N_KEYS} keys Zipf({ZIPF_A}) hits=1 "
+                       f"CAP={CAP} "
+                       f"probes={os.environ.get('GUBER_PROBES', '8')}"),
             "baseline_is": ("north-star target 50M decisions/s/chip (no "
                             "published reference numbers; BASELINE.md)"),
             "baseline_configs": {},
@@ -1218,6 +1252,12 @@ def _run_section(name, inline):
     env = dict(os.environ, GUBER_BENCH_SECTION=name,
                GUBER_BENCH_SECTION_OUT=path)
     env.pop("GUBER_BENCH_INNER", None)
+    if _PROBES_DEFAULTED:
+        # sections model general serving: default probe window (the
+        # 16-probe widening is the flagship populate shape's, set at
+        # module import — don't let children inherit it)
+        env["GUBER_PROBES"] = "8"
+        env.pop("GUBER_PROBES_BENCH_DEFAULT", None)
     if _EXPECT_BACKEND:
         env["GUBER_BENCH_EXPECT_BACKEND"] = _EXPECT_BACKEND
     # worst observed tunnel compile is ~305 s; budgets give 3× margin
@@ -1390,9 +1430,15 @@ def _watchdog_main():
         out = None
     if out is None and os.environ.get("GUBER_JAX_PLATFORM", "") != "cpu":
         log("falling back to CPU (device backend unreachable or hung)")
-        out = attempt({"GUBER_JAX_PLATFORM": "cpu",
-                       "GUBER_BENCH_FAST": "1",
-                       "GUBER_BENCH_SCAN": "4"}, 1800)
+        fast_env = {"GUBER_JAX_PLATFORM": "cpu",
+                    "GUBER_BENCH_FAST": "1",
+                    "GUBER_BENCH_SCAN": "4"}
+        if _PROBES_DEFAULTED:
+            # the parent already exported the flagship's 16-probe
+            # widening; the FAST shape (1M keys / CAP 2^21, load 0.48)
+            # serves 100% at the default window already
+            fast_env["GUBER_PROBES"] = "8"
+        out = attempt(fast_env, 1800)
         if out is not None:
             d = json.loads(out)
             prior = d["extra"].get("note", "")

@@ -300,6 +300,7 @@ def main():
             "backend": backend,
             "populate_errs": dict(populate_errs),
             "probes": int(os.environ.get("GUBER_PROBES", "8")),
+            "ksplit": int(os.environ.get("GUBER_KSPLIT", "0")),
             "config": (f"TOKEN_BUCKET {N_KEYS} keys Zipf({ZIPF_A}) hits=1 "
                        f"CAP={CAP} "
                        f"probes={os.environ.get('GUBER_PROBES', '8')}"),

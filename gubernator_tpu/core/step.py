@@ -50,6 +50,53 @@ from .table import TableState
 PROBES = int(__import__("os").environ.get("GUBER_PROBES", "8"))
 INSERT_ROUNDS = 4  # slot-claim rounds per batch
 
+#: K-split scatter fallback (GUBER_KSPLIT=<log2 window>, default off):
+#: the 2026-08-01 backend compiler serialized the donated step's table
+#: scatters at CAP >= 2^22 (217-258 ms/step) while CAP 2^21 lowered
+#: well (0.118 ms).  With GUBER_KSPLIT=21, every table-row scatter is
+#: performed as CAP/2^21 slice-local scatters whose operands are the
+#: 2^21-row size that lowers well — subtracting each window's base
+#: preserves BOTH scatter promises (an ascending+unique index vector
+#: stays ascending+unique; rows outside the window fall out of bounds
+#: and drop), so no masking is needed.  Opt-in: on backends WITHOUT
+#: the pathology it is pure overhead (measured 2x on XLA:CPU at CAP
+#: 2^22 — the per-window concatenate streams the table), so it is an
+#: escalation tier between "promises fixed it" and "serve large CAP
+#: from the Pallas kernel", A/B-able on-chip in one compile
+#: (tools/cap_ab.py records the active value; tpu_session stage 2b
+#: fires it automatically when the plain probe stays pathological).
+KSPLIT_LOG2 = int(__import__("os").environ.get("GUBER_KSPLIT", "0"))
+
+
+def _scatter_rows(col, idx, vals, *, sorted_idx: bool):
+    """Table-row scatter with the backend promises, K-split when
+    enabled (see KSPLIT_LOG2).  ``idx`` entries out of [0, len(col))
+    are drop sentinels; ``sorted_idx`` mirrors each call site's
+    indices_are_sorted claim (the insert claim vector is unique but
+    unsorted)."""
+    cap = col.shape[0]
+    if not KSPLIT_LOG2 or cap <= (1 << KSPLIT_LOG2):
+        return col.at[idx].set(vals, mode="drop", unique_indices=True,
+                               indices_are_sorted=sorted_idx)
+    S = 1 << KSPLIT_LOG2
+    # Out-of-window rows get DISTINCT >= S sentinels (dropped): a plain
+    # idx - base would send below-window rows NEGATIVE, and negative
+    # scatter indices WRAP (numpy semantics), corrupting the window's
+    # tail.  The remap keeps uniqueness but not global order, so the
+    # per-window scatters promise unique only — uniqueness is what
+    # unlocks the parallel lowering; sortedness is a secondary hint the
+    # split trades away.
+    arange_b = jnp.arange(idx.shape[0], dtype=idx.dtype)
+    parts = []
+    for k in range(cap // S):
+        base = k * S
+        loc = jnp.where((idx >= base) & (idx < base + S),
+                        idx - base, S + arange_b)
+        sl = lax.slice_in_dim(col, base, base + S)
+        parts.append(sl.at[loc].set(vals, mode="drop",
+                                    unique_indices=True))
+    return lax.concatenate(parts, 0)
+
 _RESET = int(Behavior.RESET_REMAINING)
 _DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
 _GREG = int(Behavior.DURATION_IS_GREGORIAN)
@@ -194,7 +241,7 @@ def _insert(tkey: jax.Array, slots: jax.Array, key: jax.Array,
                           cap + jnp.arange(B, dtype=cand.dtype))
         if _CHECK_SCATTER_INVARIANTS:  # trace-time test hook
             jax.debug.callback(_record_unique, "insert_tkey", claim)
-        tkey = tkey.at[claim].set(key, mode="drop", unique_indices=True)
+        tkey = _scatter_rows(tkey, claim, key, sorted_idx=False)
         row = jnp.where(winner, cand, row)
         n_claimed = n_claimed + winner.sum(dtype=jnp.int64)
 
@@ -664,18 +711,14 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     def _cold_scatter(cols):
         limit_c, duration_c, eff_c, burst_c = cols
-        return (limit_c.at[wrow].set(item_final.limit, mode="drop",
-                                     unique_indices=True,
-                                     indices_are_sorted=True),
-                duration_c.at[wrow].set(item_final.duration, mode="drop",
-                                        unique_indices=True,
-                                        indices_are_sorted=True),
-                eff_c.at[wrow].set(item_final.eff, mode="drop",
-                                   unique_indices=True,
-                                   indices_are_sorted=True),
-                burst_c.at[wrow].set(item_final.burst, mode="drop",
-                                     unique_indices=True,
-                                     indices_are_sorted=True))
+        return (_scatter_rows(limit_c, wrow, item_final.limit,
+                              sorted_idx=True),
+                _scatter_rows(duration_c, wrow, item_final.duration,
+                              sorted_idx=True),
+                _scatter_rows(eff_c, wrow, item_final.eff,
+                              sorted_idx=True),
+                _scatter_rows(burst_c, wrow, item_final.burst,
+                              sorted_idx=True))
 
     limit_n, duration_n, eff_n, burst_n = lax.cond(
         cold_dirty, _cold_scatter, lambda cols: cols,
@@ -683,22 +726,18 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
 
     new_state = TableState(
         key=tkey,
-        meta=state.meta.at[wrow].set(meta_new.astype(i32), mode="drop",
-                                     unique_indices=True,
-                                     indices_are_sorted=True),
+        meta=_scatter_rows(state.meta, wrow, meta_new.astype(i32),
+                           sorted_idx=True),
         limit=limit_n,
         duration=duration_n,
         eff_ms=eff_n,
         burst=burst_n,
-        remaining=state.remaining.at[wrow].set(item_final.rem, mode="drop",
-                                               unique_indices=True,
-                                               indices_are_sorted=True),
-        t_ms=state.t_ms.at[wrow].set(item_final.t, mode="drop",
-                                     unique_indices=True,
-                                     indices_are_sorted=True),
-        expire_at=state.expire_at.at[wrow].set(item_final.exp, mode="drop",
-                                               unique_indices=True,
-                                               indices_are_sorted=True),
+        remaining=_scatter_rows(state.remaining, wrow, item_final.rem,
+                                sorted_idx=True),
+        t_ms=_scatter_rows(state.t_ms, wrow, item_final.t,
+                           sorted_idx=True),
+        expire_at=_scatter_rows(state.expire_at, wrow, item_final.exp,
+                                sorted_idx=True),
     )
 
     # ---- back to request order -----------------------------------------

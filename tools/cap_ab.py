@@ -39,7 +39,9 @@ def main() -> int:
     i64 = jnp.int64
     out_path = "/tmp/cap_ab.json"
     res = {"backend": jax.default_backend(), "cap": cap, "n_keys": n_keys,
-           "B": B, "started": time.strftime("%Y-%m-%d %H:%M:%S")}
+           "B": B, "started": time.strftime("%Y-%m-%d %H:%M:%S"),
+           "ksplit": int(os.environ.get("GUBER_KSPLIT", "0")),
+           "probes": int(os.environ.get("GUBER_PROBES", "8"))}
 
     def dump():
         with open(out_path, "w") as f:

@@ -46,11 +46,19 @@ def main() -> int:
         behavior=jnp.zeros(n, jnp.int32), algorithm=jnp.zeros(n, jnp.int32),
         burst=jnp.full(n, 100, i64), valid=jnp.ones(n, bool))
     now = jnp.asarray(1_760_000_000_000, i64)
+    ksplit = int(os.environ.get("GUBER_KSPLIT", "0"))
+    cases = [
+        ("pallas_step", decide_batch_pallas, init_pallas_table(1 << 12)),
+        ("xla_step", decide_batch, init_table(1 << 12)),
+        ("xla_step_donated", decide_batch_donated, init_table(1 << 12)),
+    ]
+    if ksplit:
+        # the K-split rewrite only activates at CAP > 2^ksplit — lower
+        # a genuinely split table (CAP 2^22 at the default window 21)
+        cases = [(f"xla_step_donated_ksplit{ksplit}_cap22",
+                  decide_batch_donated, init_table(1 << 22))]
     failures = 0
-    for name, fn, state in (
-            ("pallas_step", decide_batch_pallas, init_pallas_table(1 << 12)),
-            ("xla_step", decide_batch, init_table(1 << 12)),
-            ("xla_step_donated", decide_batch_donated, init_table(1 << 12))):
+    for name, fn, state in cases:
         try:
             # fn is already jitted (with donate_argnums where relevant)
             # — re-wrapping in jax.jit would drop the donation and lower
@@ -60,6 +68,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             failures += 1
             print(f"{name}: LOWERING FAILED: {str(e)[:400]}")
+    if not ksplit:
+        # cover the K-split serving fallback too (fresh process: the
+        # constant is read at core.step import)
+        import subprocess
+
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=dict(os.environ, GUBER_KSPLIT="21"))
+        failures += 1 if r.returncode else 0
     return 1 if failures else 0
 
 

@@ -227,6 +227,24 @@ def main() -> int:
                         "commit what landed")
         return 1
 
+    # 2b. if the unique/sorted scatter promises did NOT fix the
+    # CAP >= 2^22 serialization, A/B the K-split fallback in the same
+    # window (GUBER_KSPLIT=21: every table scatter becomes slice-local
+    # scatters at the 2^21 operand size that lowers well) — one more
+    # compile answers whether it is the large-CAP serving mode.
+    verdict = (results.get("cap_ab22") or {}).get("verdict", "")
+    if ok and verdict == "still pathological":
+        t_ks = time.time()
+        run_stage("cap_ab22_ksplit", [sys.executable,
+                                      os.path.join(_HERE, "cap_ab.py"),
+                                      "22"], timeout=1500,
+                  env_extra={"GUBER_KSPLIT": "21"},
+                  progress_file="/tmp/cap_ab.json")
+        merge_json_file("cap_ab22_ksplit", "/tmp/cap_ab.json", t_ks)
+        if not relay_alive():
+            record("abort", "relay died during cap_ab ksplit")
+            return 1
+
     # 3. THE DRIVER-SHAPED BENCH — before any exploratory stage.  The
     # headline duel (copy/donate/pallas at 10M keys / CAP 2^24) is the
     # north-star answer AND the BENCH_rN record; bench checkpoints it

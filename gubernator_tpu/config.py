@@ -116,6 +116,13 @@ class Config:
     store: Optional[object] = None
     #: Seconds between expired-row sweeps (0 disables).
     sweep_interval_ms: int = 30_000
+    #: Decision-step implementation: "xla" (default — unbounded values,
+    #: auto-grow) or "pallas" (the hand-scheduled Mosaic kernel as the
+    #: serving mode: lowering-independent throughput floor, bucketized
+    #: table; counters must be < 2^30 and leaky eff < 2^31, no
+    #: auto-grow — parallel/pallas_engine.py).  GUBER_STEP_IMPL
+    #: overrides.
+    step_impl: str = ""
     #: Replicated hot-set capacity for GLOBAL keys (0 disables the psum
     #: tier; see parallel/hotset.py).  Active only for pod-local
     #: deployments (no cross-host peers).
@@ -204,12 +211,16 @@ class DaemonConfig:
 
     #: Path for Loader snapshots ("" disables checkpoint/resume).
     snapshot_path: str = ""
+    #: Decision-step implementation ("" → "xla"; "pallas" = the Mosaic
+    #: kernel serving mode — Config.step_impl).
+    step_impl: str = ""
 
     def instance_config(self) -> Config:
         return Config(
             cache_size=self.cache_size,
             cache_autogrow_max=self.cache_autogrow_max,
             batch_rows=self.batch_rows,
+            step_impl=self.step_impl,
             handover_on_reshard=self.handover_on_reshard,
             behaviors=self.behaviors,
             data_center=self.data_center,
@@ -291,6 +302,7 @@ def setup_daemon_config(conf_file: str = "",
     d.instance_id = src.get("GUBER_INSTANCE_ID", d.instance_id)
     d.log_level = src.get("GUBER_LOG_LEVEL", d.log_level)
     d.snapshot_path = src.get("GUBER_SNAPSHOT_PATH", d.snapshot_path)
+    d.step_impl = src.get("GUBER_STEP_IMPL", d.step_impl)
 
     b = d.behaviors
     b.batch_timeout_ms = src.get("GUBER_BATCH_TIMEOUT", b.batch_timeout_ms,

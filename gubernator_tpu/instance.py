@@ -13,6 +13,7 @@ exactly like the reference wraps around its cache.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -72,13 +73,29 @@ class V1Instance:
             n = m.shape["shard"]
             cap_local = max(config.cache_size // n, 1024)
             cap_local = 1 << (cap_local - 1).bit_length()
-            from .parallel.sharded import autogrow_limit_per_shard
+            step_impl = (os.environ.get("GUBER_STEP_IMPL")
+                         or config.step_impl or "xla")
+            if step_impl not in ("xla", "pallas"):
+                # a typo must not silently serve the wrong mode — the
+                # pallas choice carries domain restrictions the
+                # operator believes are live
+                raise ValueError(
+                    f"unknown step_impl {step_impl!r} (want 'xla' or "
+                    "'pallas')")
+            if step_impl == "pallas":
+                from .parallel.pallas_engine import PallasServingEngine
 
-            engine = ShardedEngine(
-                m, capacity_per_shard=cap_local,
-                batch_per_shard=config.batch_rows,
-                auto_grow_limit=autogrow_limit_per_shard(
-                    config.cache_autogrow_max, n, cap_local))
+                engine = PallasServingEngine(
+                    m, capacity_per_shard=cap_local,
+                    batch_per_shard=config.batch_rows)
+            else:
+                from .parallel.sharded import autogrow_limit_per_shard
+
+                engine = ShardedEngine(
+                    m, capacity_per_shard=cap_local,
+                    batch_per_shard=config.batch_rows,
+                    auto_grow_limit=autogrow_limit_per_shard(
+                        config.cache_autogrow_max, n, cap_local))
         self.engine = engine
         self._engine_mu = threading.Lock()
         from .dispatcher import Dispatcher
@@ -1363,9 +1380,9 @@ class V1Instance:
         return n > 0
 
     def engine_occupancy(self) -> int:
-        from .core.table import occupancy
-
-        return int(occupancy(self.engine.state))
+        # the engine owns its table layout (SoA columns vs the pallas
+        # engine's bucket rows) — layout-specific counting lives there
+        return self.engine.occupancy()
 
     def close(self) -> None:
         """Flush async managers, snapshot via Loader, drop peers.

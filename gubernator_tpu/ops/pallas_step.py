@@ -254,6 +254,25 @@ def init_pallas_table(capacity: int) -> PallasTable:
     return PallasTable(rows=jnp.zeros((capacity, WORDS), jnp.int32))
 
 
+def pallas_value_domain_mask(batch: RequestBatch):
+    """Per-row value-domain mask (np bool[B]): True where the row's
+    algorithm/counters/eff fit the kernel's i32 arithmetic.  Row-level
+    twin of the value checks in ``pallas_qualifies`` — the serving
+    engine uses it to scope out-of-domain rows instead of failing a
+    whole coalesced wave (ordering is not row-separable and stays a
+    batch-level property)."""
+    import numpy as np
+
+    alg = np.asarray(batch.algorithm)
+    ok = (alg == 0) | (alg == 1)
+    for col in (batch.hits, batch.limit, batch.burst):
+        c = np.asarray(col)
+        ok &= (c >= 0) & (c < VALUE_BOUND)
+    eff = np.asarray(batch.eff_ms)
+    ok &= (alg != 1) | ((eff >= 1) & (eff < EFF_BOUND))
+    return ok
+
+
 def pallas_qualifies(batch: RequestBatch) -> bool:
     """Host-side domain check (np, cheap): every valid row TOKEN_BUCKET
     or LEAKY_BUCKET with counter values inside the i32-arithmetic
@@ -676,16 +695,16 @@ def _call_kernel(rows, cols, interpret: bool):
         )(*cols, rows)
 
 
-@partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
-def decide_batch_pallas(table: PallasTable, batch: RequestBatch, now_ms,
-                        *, interpret: bool = False
-                        ) -> tuple[PallasTable, StepOutput]:
-    """Apply one decision batch (TOKEN or LEAKY rows) to the table.
+def decide_batch_pallas_impl(table: PallasTable, batch: RequestBatch,
+                             now_ms, *, interpret: bool = False
+                             ) -> tuple[PallasTable, StepOutput]:
+    """Unjitted kernel step — for embedding in larger programs (the
+    Pallas serving engine wraps it in shard_map; plain callers use the
+    jitted/donated ``decide_batch_pallas`` below).
 
     Same contract as core/step.py › decide_batch for batches inside
     the kernel's domain (``pallas_qualifies``) — the parity tests
-    assert identical decisions on shared request streams.  The table
-    buffer is donated (aliased in/out) like decide_batch_donated.
+    assert identical decisions on shared request streams.
     """
     i32, i64 = jnp.int32, jnp.int64
     cap = table.rows.shape[0]
@@ -779,3 +798,10 @@ def decide_batch_pallas(table: PallasTable, batch: RequestBatch, now_ms,
         status=status.astype(i32), remaining=remaining,
         reset_time=reset_time, limit=limit_out,
         err=vb & err, over_count=over, insert_count=inserts)
+
+
+#: Jitted/donated entry point (the bench duel + battery callers):
+#: table aliases in/out like decide_batch_donated.
+decide_batch_pallas = jax.jit(decide_batch_pallas_impl,
+                              static_argnames=("interpret",),
+                              donate_argnums=(0,))

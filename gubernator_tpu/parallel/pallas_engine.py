@@ -1,0 +1,419 @@
+"""Pallas serving engine: the hand-scheduled decision kernel as a
+deployable step mode (SURVEY §2.2; VERDICT r3 item 1's escalation —
+"make the Pallas kernel the serving mode at large CAP: it owns its
+scatters").
+
+``PallasServingEngine`` is a drop-in ``ShardedEngine`` whose per-shard
+table is the kernel's bucketized AoS layout (``[rows, 32] int32``,
+8-slot buckets — ops/pallas_step.py) instead of SoA columns, and whose
+step is the Mosaic kernel under ``shard_map``.  Everything above the
+step — wave routing, dispatcher coalescing, the wire lanes, metrics —
+is inherited unchanged; the engine protocol (gather/upsert/remove
+rows, snapshot/restore, sweep) is re-implemented on the bucket layout
+so V1Instance features (Store read/write-through, stateful handover,
+checkpoint/resume) keep working.
+
+Domain: the kernel serves TOKEN and LEAKY rows whose counters are
+< 2^30 and (leaky) eff < 2^31.  Out-of-domain rows are scoped PER ROW
+(``pallas_value_domain_mask``): they are excluded from the device step
+and surfaced as unservable (``table_full`` True) — never silently
+truncated into wrong decisions, and never allowed to fail the other
+callers the dispatcher coalesced into the same wave.  The gate covers
+both serving paths (check_packed and the pipelined launch/sync pair).
+(Per-key time monotonicity is guaranteed upstream: the engine's wave
+builder sorts pending requests by arrival time.)
+
+Not supported in this mode (documented trade-offs, not gaps a caller
+can trip silently): on-device auto-grow (bucket-full rows err and
+surface as table_full exactly like a full SoA probe window; callers
+see the same retry semantics), and the fused SoA Pallas sweep (this
+mode's sweep is a plain vectorized expire-clear over rows).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.batch import RequestBatch
+from ..ops import pallas_step as ps
+from .mesh import SHARD_AXIS
+from .sharded import PACK32, PACK64, ShardedEngine
+
+#: SoA column → (word extractor) mapping used by snapshot/gather.
+_I64_PAIRS = {"duration": (ps.W_DLO, ps.W_DHI),
+              "eff_ms": (ps.W_ELO, ps.W_EHI),
+              "t_ms": (ps.W_TLO, ps.W_THI),
+              "expire_at": (ps.W_XLO, ps.W_XHI)}
+
+
+def _join_u64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    return ((hi.astype(np.uint32).astype(np.uint64) << np.uint64(32))
+            | lo.astype(np.uint32).astype(np.uint64))
+
+
+def _join_i64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    return _join_u64(hi, lo).astype(np.int64)
+
+
+def _split_np(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    u = x.astype(np.uint64)
+    return ((u >> np.uint64(32)).astype(np.uint32).astype(np.int32),
+            u.astype(np.uint32).astype(np.int32))
+
+
+def _rows_to_columns(rows: np.ndarray) -> dict:
+    """[N, WORDS] int32 bucket rows → SoA column dict (live rows only),
+    in the store/Loader format (store.py › table_to_arrays).
+
+    ``burst`` is emitted as ``limit``: the kernel does not store burst
+    because oracle.apply_leaky overwrites item.burst from the request
+    before every read — the column is dead state everywhere except a
+    snapshot round-trip, and limit is its every-step value for token
+    rows (leaky rows re-adopt the request burst on first touch).
+    """
+    key = _join_u64(rows[:, ps.W_KHI], rows[:, ps.W_KLO])
+    live = key != 0
+    r = rows[live]
+    key = key[live]
+    alg = r[:, ps.W_ALG].astype(np.int64)
+    status = r[:, ps.W_STATUS].astype(np.int64)
+    limit = r[:, ps.W_LIMIT].astype(np.int64)
+    remaining = np.where(
+        alg == 1,
+        _join_i64(r[:, ps.W_TDHI], r[:, ps.W_TDLO]),
+        r[:, ps.W_REM].astype(np.int64))
+    out = {"key": key,
+           "meta": (alg | ((status & 1) << 1)).astype(np.int32),
+           "limit": limit, "burst": limit, "remaining": remaining}
+    for name, (wlo, whi) in _I64_PAIRS.items():
+        out[name] = _join_i64(r[:, whi], r[:, wlo])
+    return out
+
+
+def _columns_to_row_words(arrays: dict, i: int) -> np.ndarray | None:
+    """One snapshot row → 32 int32 words, or None if the row is outside
+    the kernel domain (counters >= 2^30 / leaky eff >= 2^31) — dropped
+    with a count by the caller, mirroring best-effort Loader.Load."""
+    meta = int(arrays["meta"][i])
+    alg = meta & 1
+    limit = int(arrays["limit"][i])
+    rem = int(arrays["remaining"][i])
+    eff = int(arrays["eff_ms"][i])
+    if limit >= ps.VALUE_BOUND:
+        return None
+    if alg == 1 and not (1 <= eff < ps.EFF_BOUND):
+        return None
+    if alg == 0 and rem >= ps.VALUE_BOUND:
+        return None
+    w = np.zeros(ps.WORDS, np.int32)
+    khi, klo = _split_np(np.asarray([arrays["key"][i]], np.uint64))
+    w[ps.W_KLO], w[ps.W_KHI] = klo[0], khi[0]
+    w[ps.W_STATUS] = (meta >> 1) & 1
+    w[ps.W_LIMIT] = limit
+    w[ps.W_ALG] = alg
+    if alg == 1:
+        tdhi, tdlo = _split_np(np.asarray([rem], np.int64))
+        w[ps.W_TDLO], w[ps.W_TDHI] = tdlo[0], tdhi[0]
+    else:
+        w[ps.W_REM] = rem
+    for name, (wlo, whi) in _I64_PAIRS.items():
+        hi, lo = _split_np(np.asarray([int(arrays[name][i])], np.int64))
+        w[wlo], w[whi] = lo[0], hi[0]
+    return w
+
+
+def make_pallas_step_packed(mesh, interpret: bool = False):
+    """shard_map twin of make_sharded_step_packed over the kernel:
+    (rows, a64, a32, now) → (rows, [5,B] i64 outputs, counters).  The
+    table is always donated — the kernel owns its scatters in-place."""
+    S = SHARD_AXIS
+
+    def _step(rows, a64, a32, now):
+        batch = RequestBatch(
+            key=lax.bitcast_convert_type(a64[0], jnp.uint64),
+            hits=a64[1], limit=a64[2], duration=a64[3], eff_ms=a64[4],
+            greg_end=a64[5], burst=a64[6], now=a64[7],
+            behavior=a32[0], algorithm=a32[1], valid=a32[2] != 0)
+        tbl, out = ps.decide_batch_pallas_impl(
+            ps.PallasTable(rows=rows), batch, now, interpret=interpret)
+        packed = jnp.stack([
+            out.status.astype(jnp.int64), out.remaining, out.reset_time,
+            out.limit, out.err.astype(jnp.int64)])
+        over = lax.psum(out.over_count, S)
+        ins = lax.psum(out.insert_count, S)
+        return tbl.rows, packed, (over, ins)
+
+    sharded = shard_map(
+        _step, mesh=mesh,
+        in_specs=(P(S, None), P(None, S), P(None, S), P()),
+        out_specs=(P(S, None), P(None, S), P()),
+        check_vma=False)  # pallas_call out_shape carries no vma
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+class PallasServingEngine(ShardedEngine):
+    """ShardedEngine over the kernel's bucketized table (module doc)."""
+
+    def _init_table_and_step(self) -> None:
+        if self.cap_local < ps.SLOTS or (self.cap_local
+                                         & (self.cap_local - 1)):
+            raise ValueError("rows per shard must be a power of two "
+                             f">= {ps.SLOTS}")
+        sh = NamedSharding(self.mesh, P(SHARD_AXIS, None))
+        self.state = jax.device_put(
+            jnp.zeros((self.n * self.cap_local, ps.WORDS), jnp.int32),
+            sh)
+        # interpret everywhere the Mosaic kernel can't compile natively
+        # (same gate as sharded.py's fused sweep)
+        self._interpret = jax.default_backend() != "tpu"
+        self._step = make_pallas_step_packed(self.mesh,
+                                             interpret=self._interpret)
+        self._rows_sharding = sh
+
+    # ---- serving -------------------------------------------------------
+
+    def _mask_out_of_domain(self, batch):
+        """Invalidate rows outside the kernel's value domain; returns
+        (masked batch, ood index array or None)."""
+        mask = ps.pallas_value_domain_mask(batch)
+        v = np.asarray(batch.valid)
+        ood = v & ~mask
+        if not ood.any():
+            return batch, None
+        return (batch._replace(valid=jnp.asarray(v & mask)),
+                np.nonzero(ood)[0])
+
+    @staticmethod
+    def _merge_ood(cols, ood):
+        """Out-of-domain rows come back as unservable (table_full) with
+        zeroed outputs — scoped to the offending rows, the same shape a
+        full probe window presents."""
+        if ood is None:
+            return cols
+        st, lim, rem, rst, full = cols
+        full = np.array(full, copy=True)
+        full[ood] = True
+        return st, lim, rem, rst, full
+
+    def check_packed(self, batch, khash, now_ms: int) -> tuple:
+        batch, ood = self._mask_out_of_domain(batch)
+        return self._merge_ood(
+            super().check_packed(batch, khash, now_ms), ood)
+
+    def launch_packed(self, batch, khash, now_ms: int):
+        # the pipelined dispatcher path calls launch/sync directly —
+        # the domain gate must cover it too
+        batch, ood = self._mask_out_of_domain(batch)
+        return (super().launch_packed(batch, khash, now_ms), ood)
+
+    def sync_packed(self, token, engine_lock=None) -> tuple:
+        inner, ood = token
+        return self._merge_ood(
+            super().sync_packed(inner, engine_lock=engine_lock), ood)
+
+    def _try_auto_grow(self, grew: list) -> bool:
+        return False  # no on-device grow for the bucket layout (doc)
+
+    def grow(self, new_cap_per_shard: int) -> int:
+        raise NotImplementedError(
+            "pallas serving mode has no on-device grow; size rows up "
+            "front (bucket-full rows err as table_full)")
+
+    # ---- sweep ---------------------------------------------------------
+
+    def sweep(self, now_ms: int) -> None:
+        """Expire-clear over bucket rows: zero every slot whose
+        expire_at <= now (whole row, so leaky td state can't leak into
+        a future occupant).  Elementwise per shard — no collective."""
+        if not hasattr(self, "_sweep_fn"):
+            S = SHARD_AXIS
+
+            def _one(rows, now):
+                exp = (rows[:, ps.W_XHI].astype(jnp.int64) << 32) | (
+                    rows[:, ps.W_XLO].astype(jnp.int64)
+                    & jnp.int64(0xFFFFFFFF))
+                live = ((rows[:, ps.W_KLO] != 0)
+                        | (rows[:, ps.W_KHI] != 0))
+                expired = live & (now >= exp)
+                rows = jnp.where(expired[:, None], jnp.int32(0), rows)
+                n_live = lax.psum((live & ~expired).sum(dtype=jnp.int64),
+                                  S)
+                return rows, n_live
+
+            self._sweep_fn = jax.jit(shard_map(
+                _one, mesh=self.mesh, in_specs=(P(S, None), P()),
+                out_specs=(P(S, None), P()), check_vma=False),
+                donate_argnums=(0,))
+        self.state, live = self._sweep_fn(
+            self.state, jnp.asarray(now_ms, jnp.int64))
+        self.live_rows = int(live)
+        self.sweep_count += 1
+
+    # ---- row ops (bucket-level, cold path) -----------------------------
+
+    def _bucket_indices(self, khash: np.ndarray) -> np.ndarray:
+        """[m, SLOTS] global row indices of each key's bucket."""
+        from ..hashing import shard_of
+
+        nb = self.cap_local // ps.SLOTS
+        shard = shard_of(khash, self.n).astype(np.int64)
+        bucket = (khash & np.uint64(nb - 1)).astype(np.int64)
+        base = shard * self.cap_local + bucket * ps.SLOTS
+        return base[:, None] + np.arange(ps.SLOTS)[None, :]
+
+    def _fetch_buckets(self, idx: np.ndarray) -> np.ndarray:
+        """Gather [m, SLOTS, WORDS] bucket copies to host."""
+        take = jnp.asarray(idx.reshape(-1))
+        # .copy(): np.asarray of a jax array is a read-only view and
+        # the callers mutate these buckets in place
+        return np.asarray(jnp.take(self.state, take, axis=0)).reshape(
+            idx.shape[0], ps.SLOTS, ps.WORDS).copy()
+
+    def _write_buckets(self, idx: np.ndarray, rows: np.ndarray) -> None:
+        flat_idx = jnp.asarray(idx.reshape(-1))
+        flat_rows = jnp.asarray(rows.reshape(-1, ps.WORDS))
+        # duplicate buckets in one call carry identical content (the
+        # caller mutates a shared host copy per bucket), so last-write
+        # equivalence holds even without a uniqueness promise
+        if not hasattr(self, "_write_fn"):
+            # cached: a fresh lambda per call would retrace+recompile
+            # the scatter on every store write-through
+            self._write_fn = jax.jit(lambda s, i, r: s.at[i].set(r),
+                                     donate_argnums=(0,))
+        self.state = self._write_fn(self.state, flat_idx, flat_rows)
+
+    def gather_rows(self, khash: np.ndarray) -> tuple[np.ndarray, dict]:
+        m = len(khash)
+        found = np.zeros(m, bool)
+        cols = {f: np.zeros(m, np.int64) for f in
+                ("meta", "limit", "duration", "eff_ms", "burst",
+                 "remaining", "t_ms", "expire_at")}
+        cols["meta"] = cols["meta"].astype(np.int32)
+        if m == 0:
+            return found, cols
+        idx = self._bucket_indices(khash)
+        buckets = self._fetch_buckets(idx)
+        khi, klo = _split_np(khash)
+        for i in range(m):
+            b = buckets[i]
+            hit = np.nonzero((b[:, ps.W_KLO] == klo[i])
+                             & (b[:, ps.W_KHI] == khi[i]))[0]
+            if not hit.size:
+                continue
+            found[i] = True
+            cvt = _rows_to_columns(b[hit[:1]])
+            for f in cols:
+                cols[f][i] = cvt[f][0]
+        return found, cols
+
+    def upsert_rows(self, khash: np.ndarray, cols: dict) -> int:
+        if len(khash) == 0:
+            return 0
+        arrays = dict(cols)
+        arrays["key"] = khash.astype(np.uint64)
+        idx = self._bucket_indices(khash)
+        # ONE batched device fetch, then one shared host copy per
+        # distinct bucket so multiple keys upserted into the same
+        # bucket see each other's claims (a per-key fetch would cost a
+        # blocking device round trip per bucket)
+        all_buckets = self._fetch_buckets(idx)
+        bucket_cache: dict = {}
+        placed = 0
+        khi, klo = _split_np(khash)
+        for i in range(len(khash)):
+            key0 = int(idx[i, 0])
+            if key0 not in bucket_cache:
+                bucket_cache[key0] = all_buckets[i]
+            b = bucket_cache[key0]
+            w = _columns_to_row_words(arrays, i)
+            if w is None:
+                self.dropped_rows += 1
+                continue
+            hit = np.nonzero((b[:, ps.W_KLO] == klo[i])
+                             & (b[:, ps.W_KHI] == khi[i]))[0]
+            if hit.size:
+                slot = hit[0]
+            else:
+                empty = np.nonzero((b[:, ps.W_KLO] == 0)
+                                   & (b[:, ps.W_KHI] == 0))[0]
+                if not empty.size:
+                    self.dropped_rows += 1
+                    continue
+                slot = empty[0]
+            b[slot] = w
+            placed += 1
+        if bucket_cache:
+            bases = np.asarray(sorted(bucket_cache), np.int64)
+            rows = np.stack([bucket_cache[int(k)] for k in bases])
+            self._write_buckets(
+                bases[:, None] + np.arange(ps.SLOTS)[None, :], rows)
+        return placed
+
+    def remove_rows(self, khash: np.ndarray) -> int:
+        if len(khash) == 0:
+            return 0
+        idx = self._bucket_indices(khash)
+        buckets = self._fetch_buckets(idx)
+        khi, klo = _split_np(khash)
+        removed = 0
+        dirty = []
+        for i in range(len(khash)):
+            b = buckets[i]
+            hit = np.nonzero((b[:, ps.W_KLO] == klo[i])
+                             & (b[:, ps.W_KHI] == khi[i]))[0]
+            if hit.size:
+                b[hit] = 0
+                removed += 1
+                dirty.append(i)
+        if dirty:
+            d = np.asarray(dirty)
+            self._write_buckets(idx[d], buckets[d])
+        return removed
+
+    def occupancy(self) -> int:
+        if not hasattr(self, "_occ_fn"):
+            self._occ_fn = jax.jit(lambda r: (
+                (r[:, ps.W_KLO] != 0) | (r[:, ps.W_KHI] != 0)
+            ).sum(dtype=jnp.int64))
+        return int(self._occ_fn(self.state))
+
+    # ---- checkpoint/resume ---------------------------------------------
+
+    def snapshot(self) -> dict:
+        return _rows_to_columns(np.asarray(self.state))
+
+    def restore(self, arrays: dict) -> int:
+        host = np.asarray(self.state).copy()
+        keys = arrays["key"].astype(np.uint64)
+        idx = self._bucket_indices(keys)
+        khi, klo = _split_np(keys)
+        placed = 0
+        for i in range(len(keys)):
+            b = host[idx[i]]
+            w = _columns_to_row_words(arrays, i)
+            if w is None:
+                self.dropped_rows += 1
+                continue
+            hit = np.nonzero((b[:, ps.W_KLO] == klo[i])
+                             & (b[:, ps.W_KHI] == khi[i]))[0]
+            slot = None
+            if hit.size:
+                slot = hit[0]
+            else:
+                empty = np.nonzero((b[:, ps.W_KLO] == 0)
+                                   & (b[:, ps.W_KHI] == 0))[0]
+                if empty.size:
+                    slot = empty[0]
+            if slot is None:
+                self.dropped_rows += 1
+                continue
+            host[idx[i, slot]] = w
+            placed += 1
+        self.state = jax.device_put(jnp.asarray(host),
+                                    self._rows_sharding)
+        return placed

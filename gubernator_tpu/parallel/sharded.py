@@ -304,17 +304,7 @@ class ShardedEngine:
         #: reference's LRU never fails an insert; with auto-grow on,
         #: neither do we until this bound.
         self.auto_grow_limit = auto_grow_limit
-        self.state = shard_table(self.mesh, capacity_per_shard)
-        # The serving step aliases the table in/out by default
-        # (GUBER_STEP_DONATE=0 opts out): clean-step cold columns pass
-        # through copy-free and row scatters update in place (see
-        # core/step.py › decide_batch_donated).  Measured on a real v5e
-        # (tools/tpu_session.py, 2026-07-31): donate 0.573 ms/step vs
-        # copy 209 ms at CAP 2^21 — non-donated scatters serialize on
-        # TPU — and donate also wins 6.3× on CPU (PERF.md §5).
-        self._step = make_sharded_step_packed(
-            self.mesh,
-            donate=_os.environ.get("GUBER_STEP_DONATE", "1") == "1")
+        self._init_table_and_step()
         self._batch_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
         self._mat_sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
         self._repl = NamedSharding(self.mesh, P())
@@ -328,6 +318,24 @@ class ShardedEngine:
         self._pallas_sweep_fn = None
         self._grow_fns: dict = {}  # cap_new → compiled grow program
         self.dropped_rows = 0  # rows lost to grow/restore re-placement
+
+    def _init_table_and_step(self) -> None:
+        """Build self.state + self._step (subclass hook: the Pallas
+        serving engine swaps in its bucketized table + kernel step).
+
+        The serving step aliases the table in/out by default
+        (GUBER_STEP_DONATE=0 opts out): clean-step cold columns pass
+        through copy-free and row scatters update in place (see
+        core/step.py › decide_batch_donated).  Measured on a real v5e
+        (tools/tpu_session.py, 2026-07-31): donate 0.573 ms/step vs
+        copy 209 ms at CAP 2^21 — non-donated scatters serialize on
+        TPU — and donate also wins 6.3× on CPU (PERF.md §5)."""
+        import os as _os
+
+        self.state = shard_table(self.mesh, self.cap_local)
+        self._step = make_sharded_step_packed(
+            self.mesh,
+            donate=_os.environ.get("GUBER_STEP_DONATE", "1") == "1")
 
     def sweep(self, now_ms: int) -> None:
         """Reclaim expired rows on every shard (elementwise on the
@@ -720,6 +728,12 @@ class ShardedEngine:
                 self.state, jax.device_put(keys, self._batch_sharding))
             removed += int(np.asarray(found)[slots].sum())
         return removed
+
+    def occupancy(self) -> int:
+        """Live (non-empty) rows right now — health/metrics surface."""
+        from ..core.table import occupancy
+
+        return int(occupancy(self.state))
 
     def each(self):
         """Iterate live rows as store.CacheItem objects (Cache.Each

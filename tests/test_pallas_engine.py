@@ -1,0 +1,234 @@
+"""PallasServingEngine: the Mosaic kernel as a deployable serving mode.
+
+Engine-protocol parity vs ShardedEngine (the XLA mode) on shared
+request streams — decisions, sweep, row ops, snapshot/restore — plus
+the domain gate.  Runs the kernel in interpret mode on CPU (same
+reference interpreter as test_pallas_step.py)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gubernator_tpu.hashing import hash_request_keys
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.parallel.pallas_engine import PallasServingEngine
+from gubernator_tpu.types import RateLimitRequest
+
+NOW = 1_765_000_000_000
+
+
+def req(key, **kw):
+    d = dict(hits=1, limit=10, duration=10_000)
+    d.update(kw)
+    return RateLimitRequest(name="pe", unique_key=key, **d)
+
+
+@pytest.fixture()
+def engines():
+    mesh = make_mesh(n=2)
+    pe = PallasServingEngine(mesh, capacity_per_shard=1 << 9,
+                             batch_per_shard=64)
+    xe = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
+                       batch_per_shard=64)
+    return pe, xe
+
+
+def both(engines, reqs, now):
+    pe, xe = engines
+    rp = pe.check_batch(reqs, now)
+    rx = xe.check_batch(reqs, now)
+    for i, (a, b) in enumerate(zip(rp, rx)):
+        assert (int(a.status), a.remaining, a.reset_time, a.limit) == \
+            (int(b.status), b.remaining, b.reset_time, b.limit), i
+    return rp
+
+
+class TestServingParity:
+    def test_token_flow_and_counters(self, engines):
+        pe, xe = engines
+        reqs = [req(f"k{i % 6}", hits=2) for i in range(24)]
+        both(engines, reqs, NOW)
+        both(engines, reqs, NOW + 500)
+        # deny region
+        both(engines, reqs, NOW + 600)
+        assert pe.over_count == xe.over_count
+        assert pe.insert_count == xe.insert_count
+        # expiry → fresh
+        both(engines, reqs, NOW + 30_000)
+
+    def test_leaky_flow(self, engines):
+        reqs = [req(f"l{i % 4}", algorithm=1, hits=3, limit=100,
+                    burst=100, duration=60_000) for i in range(16)]
+        both(engines, reqs, NOW)
+        both(engines, reqs, NOW + 2_000)
+        both(engines, reqs, NOW + 90_000)
+
+    def test_mixed_algorithms_and_flags(self, engines):
+        rng = np.random.default_rng(3)
+        reqs = []
+        for i in range(48):
+            alg = i % 2
+            beh = 8 if i % 7 == 0 else (32 if i % 11 == 0 else 0)
+            reqs.append(req(f"m{i % 9}", algorithm=alg,
+                            hits=int(rng.integers(0, 4)),
+                            limit=20, burst=20, behavior=beh))
+        both(engines, reqs, NOW)
+        both(engines, reqs, NOW + 100)
+
+    def test_out_of_domain_rows_scoped_not_fatal(self, engines):
+        """A row outside the kernel's value domain must not fail the
+        wave (the dispatcher coalesces independent callers): it comes
+        back unservable ('rate limit table full') while every other
+        row serves normally — and the device state is untouched by it."""
+        pe, _ = engines
+        resps = pe.check_batch(
+            [req("ok1", limit=5), req("big", limit=1 << 31),
+             req("ok2", limit=5)], NOW)
+        assert resps[0].error == "" and resps[0].remaining == 4
+        assert resps[2].error == "" and resps[2].remaining == 4
+        assert "full" in resps[1].error
+        # the out-of-domain key left no row behind
+        kh = hash_request_keys(["pe"], ["big"])
+        found, _ = pe.gather_rows(kh)
+        assert not found.any()
+
+    def test_out_of_domain_rows_scoped_pipelined(self, engines):
+        """Same scoping through the pipelined launch/sync pair (the
+        TPU dispatcher path calls these directly)."""
+        from gubernator_tpu.core.batch import pack_requests
+
+        pe, _ = engines
+        reqs = [req("p1", limit=5), req("huge", hits=1 << 31),
+                req("p2", limit=5)]
+        kh = hash_request_keys(["pe"] * 3, ["p1", "huge", "p2"])
+        batch, _errs = pack_requests(reqs, NOW, size=3, key_hashes=kh)
+        token = pe.launch_packed(batch, kh, NOW)
+        st, lim, rem, rst, full = pe.sync_packed(token)
+        assert list(full) == [False, True, False]
+        assert rem[0] == 4 and rem[2] == 4
+
+    def test_sweep_reclaims_expired(self, engines):
+        pe, xe = engines
+        reqs = [req(f"s{i}") for i in range(12)]
+        both(engines, reqs, NOW)
+        pe.sweep(NOW + 60_000)
+        xe.sweep(NOW + 60_000)
+        assert pe.live_rows == 0
+        # the slots actually free again (fresh inserts succeed)
+        both(engines, reqs, NOW + 61_000)
+
+    def test_sweep_keeps_live_rows(self, engines):
+        pe, _ = engines
+        both(engines, [req(f"sl{i}") for i in range(5)], NOW)
+        pe.sweep(NOW + 1_000)  # inside the 10s window
+        assert pe.live_rows == 5
+
+
+class TestRowOps:
+    def test_gather_upsert_remove_roundtrip(self, engines):
+        pe, xe = engines
+        reqs = [req(f"r{i}", hits=4) for i in range(8)]
+        both(engines, reqs, NOW)
+        kh = hash_request_keys(["pe"] * 8,
+                               [f"r{i}" for i in range(8)])
+        fp, cp = pe.gather_rows(kh)
+        fx, cx = xe.gather_rows(kh)
+        assert fp.all() and fx.all()
+        for f in ("meta", "limit", "remaining", "t_ms", "expire_at",
+                  "duration", "eff_ms"):
+            assert (cp[f] == cx[f]).all(), f
+        # upsert modified state into BOTH engines → still in lockstep
+        cp["remaining"] = cp["remaining"] + 3
+        assert pe.upsert_rows(kh, cp) == 8
+        assert xe.upsert_rows(kh, cp) == 8
+        both(engines, [req(f"r{i}", hits=0) for i in range(8)], NOW + 10)
+        # remove → keys re-insert fresh
+        assert pe.remove_rows(kh[:4]) == 4
+        assert xe.remove_rows(kh[:4]) == 4
+        both(engines, reqs, NOW + 20)
+
+    def test_gather_missing_keys(self, engines):
+        pe, _ = engines
+        kh = hash_request_keys(["pe"], ["never-seen"])
+        found, _ = pe.gather_rows(kh)
+        assert not found.any()
+
+
+class TestSnapshotRestore:
+    def test_snapshot_matches_xla_columns(self, engines):
+        pe, xe = engines
+        reqs = [req(f"ss{i}", hits=2) for i in range(10)]
+        both(engines, reqs, NOW)
+        sp = pe.snapshot()
+        sx = xe.snapshot()
+        op = np.argsort(sp["key"])
+        ox = np.argsort(sx["key"])
+        assert (sp["key"][op] == sx["key"][ox]).all()
+        for f in ("meta", "limit", "remaining", "t_ms", "expire_at"):
+            assert (sp[f][op] == sx[f][ox]).all(), f
+
+    def test_restore_roundtrip_across_engine_kinds(self):
+        """An XLA-engine snapshot restores into a pallas engine (and
+        back): checkpoint/resume is layout-independent."""
+        xe = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
+                           batch_per_shard=64)
+        reqs = [req(f"x{i}", hits=3) for i in range(9)]
+        xe.check_batch(reqs, NOW)
+        snap = xe.snapshot()
+
+        pe = PallasServingEngine(make_mesh(n=2),
+                                 capacity_per_shard=1 << 9,
+                                 batch_per_shard=64)
+        assert pe.restore(snap) == 9
+        # restored counters serve identically
+        q = [req(f"x{i}", hits=0) for i in range(9)]
+        rp = pe.check_batch(q, NOW + 5)
+        rx = xe.check_batch(q, NOW + 5)
+        for a, b in zip(rp, rx):
+            assert (int(a.status), a.remaining) == \
+                (int(b.status), b.remaining)
+        # and back: pallas snapshot → fresh XLA engine
+        xe2 = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
+                            batch_per_shard=64)
+        assert xe2.restore(pe.snapshot()) == 9
+        rx2 = xe2.check_batch(q, NOW + 6)
+        rp2 = pe.check_batch(q, NOW + 6)
+        for a, b in zip(rx2, rp2):
+            assert (int(a.status), a.remaining) == \
+                (int(b.status), b.remaining)
+
+    def test_restore_drops_out_of_domain_rows(self):
+        xe = ShardedEngine(make_mesh(n=2), capacity_per_shard=1 << 9,
+                           batch_per_shard=64)
+        xe.check_batch([req("huge", limit=1 << 40),
+                        req("ok", limit=5)], NOW)
+        snap = xe.snapshot()
+        pe = PallasServingEngine(make_mesh(n=2),
+                                 capacity_per_shard=1 << 9,
+                                 batch_per_shard=64)
+        assert pe.restore(snap) == 1
+        assert pe.dropped_rows == 1
+
+
+class TestInstanceIntegration:
+    def test_v1instance_pallas_mode(self, monkeypatch):
+        from gubernator_tpu.config import Config
+        from gubernator_tpu.instance import V1Instance
+        from gubernator_tpu.parallel.pallas_engine import (
+            PallasServingEngine)
+
+        # env has precedence over Config — an inherited override would
+        # flip the engine under test
+        monkeypatch.delenv("GUBER_STEP_IMPL", raising=False)
+        inst = V1Instance(Config(cache_size=1 << 10,
+                                 sweep_interval_ms=0,
+                                 step_impl="pallas"),
+                          mesh=make_mesh(n=1))
+        try:
+            assert isinstance(inst.engine, PallasServingEngine)
+            resps = inst.get_rate_limits(
+                [req("v1", limit=3) for _ in range(5)], now_ms=NOW)
+            assert [int(r.status) for r in resps] == [0, 0, 0, 1, 1]
+            assert [r.remaining for r in resps] == [2, 1, 0, 0, 0]
+        finally:
+            inst.close()

@@ -69,6 +69,26 @@ def main() -> int:
             failures += 1
             print(f"{name}: LOWERING FAILED: {str(e)[:400]}")
     if not ksplit:
+        # the pallas SERVING engine's sharded step (kernel under
+        # shard_map + packed wire layout) is its own lowering surface
+        try:
+            from gubernator_tpu.ops.pallas_step import WORDS
+            from gubernator_tpu.parallel import make_mesh
+            from gubernator_tpu.parallel.pallas_engine import (
+                make_pallas_step_packed)
+
+            mesh = make_mesh(n=1)
+            step = make_pallas_step_packed(mesh)
+            rows = jnp.zeros((1 << 12, WORDS), jnp.int32)
+            a64 = jnp.zeros((8, n), jnp.int64)
+            a32 = jnp.zeros((3, n), jnp.int32)
+            step.trace(rows, a64, a32, now).lower(
+                lowering_platforms=("tpu",))
+            print("pallas_engine_step: lowers for TPU")
+        except Exception as e:  # noqa: BLE001
+            failures += 1
+            print(f"pallas_engine_step: LOWERING FAILED: {str(e)[:400]}")
+    if not ksplit:
         # cover the K-split serving fallback too (fresh process: the
         # constant is read at core.step import)
         import subprocess

@@ -210,6 +210,53 @@ class TestSnapshotRestore:
         assert pe.dropped_rows == 1
 
 
+class TestStoreIntegration:
+    def test_store_write_and_read_through_pallas_mode(self, monkeypatch):
+        """The Store subsystem (persistence hooks) runs unchanged over
+        the bucket layout: write-through sees mutations via the bucket
+        row ops; a fresh pallas-mode instance read-through-seeds from
+        persisted state."""
+        from gubernator_tpu.config import Config
+        from gubernator_tpu.instance import V1Instance
+        from gubernator_tpu.store import CacheItem, MockStore
+        from gubernator_tpu.types import RateLimitRequest
+
+        monkeypatch.delenv("GUBER_STEP_IMPL", raising=False)
+
+        def sreq(**kw):
+            d = dict(hits=1, limit=10, duration=60_000)
+            d.update(kw)
+            return RateLimitRequest(name="rt", unique_key="k1", **d)
+
+        store = MockStore()
+        inst = V1Instance(Config(cache_size=1 << 10, store=store,
+                                 sweep_interval_ms=0,
+                                 step_impl="pallas"),
+                          mesh=make_mesh(n=2))
+        try:
+            r = inst.get_rate_limits([sreq()], now_ms=NOW)[0]
+            assert r.remaining == 9
+            assert store.called["on_change"] == 1
+            assert store.items["rt_k1"].remaining == 9
+        finally:
+            inst.close()
+
+        # a SECOND pallas instance seeds from the persisted row
+        store.items["rt_k1"] = CacheItem(
+            key="rt_k1", limit=10, duration=60_000, eff_ms=60_000,
+            remaining=3, t_ms=NOW, expire_at=NOW + 60_000)
+        inst2 = V1Instance(Config(cache_size=1 << 10, store=store,
+                                  sweep_interval_ms=0,
+                                  step_impl="pallas"),
+                           mesh=make_mesh(n=2))
+        try:
+            r = inst2.get_rate_limits([sreq(hits=0)],
+                                      now_ms=NOW + 1000)[0]
+            assert r.remaining == 3, "store state not seeded"
+        finally:
+            inst2.close()
+
+
 class TestInstanceIntegration:
     def test_v1instance_pallas_mode(self, monkeypatch):
         from gubernator_tpu.config import Config

@@ -1186,6 +1186,70 @@ def _sec_cfg5():
                                       "capacity_attempted": int(cap5)}}
 
 
+def _sec_pallas():
+    """step_impl=pallas as the SERVING mode (VERDICT r4 item 2a): the
+    full V1Instance wire path — bytes → dispatcher → Mosaic-kernel
+    step → bytes — over PallasServingEngine at the large-CAP shape the
+    mode exists for (the CAP ≥ 2^22 scatter-pathology escalation
+    tier).  On CPU the kernel runs in interpret mode, orders slower
+    than XLA by construction, so the fallback shape is tiny and the
+    row says so; only the TPU row is a real serving measurement."""
+    import jax
+
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.pallas_engine import PallasServingEngine
+
+    cpu = jax.default_backend() == "cpu"
+    cap = 1 << 12 if cpu else 1 << 24  # 2 GiB of rows on-chip
+    reps = 4 if cpu else 20
+    rng = np.random.default_rng(7)
+    row = {"capacity": cap, "cpu_interpret_reduced": cpu, "batch": 1000}
+    # env GUBER_STEP_IMPL would override Config and silently measure
+    # the wrong engine — force it for this row, restore after
+    prev_impl = os.environ.get("GUBER_STEP_IMPL")
+    os.environ["GUBER_STEP_IMPL"] = "pallas"
+    try:
+        inst = V1Instance(Config(cache_size=cap, sweep_interval_ms=0,
+                                 step_impl="pallas"),
+                          mesh=make_mesh(n=1))
+    finally:
+        if prev_impl is None:
+            os.environ.pop("GUBER_STEP_IMPL", None)
+        else:
+            os.environ["GUBER_STEP_IMPL"] = prev_impl
+    try:
+        assert isinstance(inst.engine, PallasServingEngine)
+        datas = _serialize_reqs(_make_reqs(rng))
+        if cpu:
+            datas = datas[:2]
+        inst.get_rate_limits_wire(datas[0], now_ms=NOW0)  # compile
+        t0 = time.perf_counter()
+        for r in range(reps):
+            inst.get_rate_limits_wire(datas[r % len(datas)],
+                                      now_ms=NOW0 + 1 + r)
+        row["wire_lane_decisions_per_s"] = round(
+            reps * 1000 / (time.perf_counter() - t0))
+        lat = []
+        for r in range(8 if cpu else 60):
+            t0 = time.perf_counter()
+            inst.get_rate_limits_wire(datas[r % len(datas)],
+                                      now_ms=NOW0 + 40 + r)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        row["svc_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+        row["svc_p99_ms"] = round(float(np.percentile(lat, 99)), 3)
+        row["occupancy"] = int(inst.engine.occupancy())
+    finally:
+        inst.close()
+    if cpu:
+        row["context"] = (
+            "CPU fallback runs the kernel in INTERPRET mode at a toy "
+            "shape — proves the serving path end-to-end, measures "
+            "nothing; the TPU row is the large-CAP serving claim")
+    return {"11_pallas_serving": row}
+
+
 #: section name → (callable, result row keys for skip/error reporting)
 _SECTIONS = {
     "lat_client": (_sec_lat_client,
@@ -1198,10 +1262,12 @@ _SECTIONS = {
     "group": (_sec_group, ["10_reuseport_group"]),
     "hot": (_sec_hot, ["7_hot_psum"]),
     "cfg5": (_sec_cfg5, ["5_gregorian_churn"]),
+    "pallas": (_sec_pallas, ["11_pallas_serving"]),
 }
 
 #: device sections that each pay a fresh compile, in run order
-_SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot", "cfg5"]
+_SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot",
+                  "cfg5", "pallas"]
 
 _WEDGED = False  # set when a section timeout + failed device probe
 #: parent's backend, captured BEFORE the device client is released —
@@ -1330,13 +1396,18 @@ def run_secondary_configs(step_mode, backend, checkpoint=None):
     runs after each section so rows measured before a late-stage
     device failure survive (see _write_partial)."""
     # serving engines in the sections read this at construction: they
-    # must run the best XLA mode (the engines don't serve the pallas
-    # kernel) — set it explicitly BOTH ways so a pre-existing operator
-    # export can't make the rows measure a different mode than
-    # reported (children inherit it)
+    # must run the best XLA mode — set it explicitly BOTH ways so a
+    # pre-existing operator export can't make the rows measure a
+    # different mode than reported (children inherit it).  The one
+    # exception is the dedicated `pallas` section (11_pallas_serving),
+    # which forces GUBER_STEP_IMPL=pallas for its own instance.
     os.environ["GUBER_STEP_DONATE"] = ("1" if step_mode == "donate"
                                       else "0")
     os.environ["GUBER_BENCH_STEP_MODE"] = step_mode
+    # env beats Config in V1Instance's step_impl resolution, so an
+    # operator's exported GUBER_STEP_IMPL=pallas would silently turn
+    # every XLA-labeled serving row into a pallas measurement
+    os.environ["GUBER_STEP_IMPL"] = "xla"
     inline = backend == "cpu"
     out = {}
     for name in _SECTION_ORDER:

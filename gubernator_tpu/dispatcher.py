@@ -130,6 +130,14 @@ class Dispatcher:
             return False  # _submit raises the closed error uniformly
         if not self._inline_mu.acquire(blocking=False):
             return False
+        if self._closing.is_set():
+            # re-checked under _inline_mu: close() drains inliners by
+            # acquiring this mutex AFTER setting _closing, so passing
+            # the first check and then acquiring late must not start
+            # an engine call after close() returned (it would race the
+            # close-time checkpoint snapshot) — ADVICE r4
+            self._inline_mu.release()
+            return False
         if not self._queue.empty():
             # a job slipped in: let the worker coalesce it with ours
             self._inline_mu.release()

@@ -75,6 +75,14 @@ class Metrics:
             "gubernator_hotset_demotions",
             "hot-set pinned keys demoted back to the sharded path",
             ["reason"], registry=r)
+        # pallas-mode capacity safety (VERDICT r4 item 6): no on-device
+        # grow, so full buckets — not total occupancy — are where new
+        # keys start erring as table_full.  0 in xla mode.
+        self.bucket_saturation = Gauge(
+            "gubernator_pallas_bucket_saturation",
+            "fraction of 8-slot buckets that are FULL (pallas serving "
+            "mode; new keys hashing into a full bucket are unservable)",
+            registry=r)
 
     @contextmanager
     def time_func(self, name: str):

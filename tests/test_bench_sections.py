@@ -56,7 +56,8 @@ def test_section_registry_covers_baseline_rows():
     for row in ["1_single_key_smoke", "2_leaky_1k_keys",
                 "4_global_sharded", "5_gregorian_churn",
                 "6_service_path", "7_hot_psum", "8_peer_path",
-                "9_clustered_service", "10_reuseport_group"]:
+                "9_clustered_service", "10_reuseport_group",
+                "11_pallas_serving"]:
         assert row in declared, row
     for name in bench._SECTION_ORDER:
         assert name in bench._SECTIONS

@@ -86,6 +86,40 @@ def test_close_rejects_new_and_drains(engine):
         d.check_batch([req("post")], NOW)
 
 
+def test_inline_never_starts_after_close(engine):
+    """ADVICE r4 (low): a caller that passes _try_inline's first
+    closing check and is then preempted across a full close() must NOT
+    win the inline path — close()'s drain guarantee is that no
+    dispatcher-initiated engine call STARTS after it returns (the
+    close-time checkpoint snapshot depends on it).  The preemption is
+    simulated deterministically: the inline mutex's acquire runs
+    close() to completion before actually acquiring."""
+    d = Dispatcher(engine)
+    real_mu = d._inline_mu
+
+    class RacingLock:
+        def acquire(self, blocking=True):
+            if not d._closing.is_set():
+                d.close()  # completes fully: sets closing + drains
+            return real_mu.acquire(blocking)
+
+        def release(self):
+            real_mu.release()
+
+        def __enter__(self):
+            real_mu.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            real_mu.release()
+
+    d._inline_mu = RacingLock()
+    assert d._try_inline() is False
+    # the mutex was released on the refusal path
+    assert real_mu.acquire(blocking=False)
+    real_mu.release()
+
+
 def test_merged_cross_now_batch_matches_sequential_oracle():
     """Per-request arrival times: a single launch holding requests from
     three different wall-clock instants (interleaved, out of order in

@@ -21,3 +21,12 @@ def test_global_hotset_example():
     import runpy
 
     runpy.run_path("examples/global_hotset.py", run_name="__main__")
+
+
+def test_pallas_serving_example(capsys, monkeypatch):
+    monkeypatch.delenv("GUBER_STEP_IMPL", raising=False)
+    runpy.run_path("examples/pallas_serving.py", run_name="__main__")
+    out = capsys.readouterr().out
+    assert "over the kernel" in out
+    assert "under_limit=512" in out
+    assert "bucket saturation 0/" in out

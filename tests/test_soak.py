@@ -101,3 +101,80 @@ def test_soak_mixed_traffic_with_churn():
         assert LIMIT <= admitted["strict"] <= 2 * LIMIT, admitted
     finally:
         cluster.stop()
+
+
+def test_soak_pallas_serving_mode_with_churn(monkeypatch):
+    """The same chaos shape over step_impl=pallas: mixed token/leaky/
+    GLOBAL traffic + membership churn with stateful handover, so the
+    kernel serving mode's row ops (gather/upsert/remove — the
+    vectorized bucket paths) carry a real cluster's re-homing, not
+    just unit fixtures.  Condensed load: interpret-mode steps on CPU
+    are the cost, the coverage is the cluster mechanics."""
+    monkeypatch.delenv("GUBER_STEP_IMPL", raising=False)
+    mesh = make_mesh(n=2)
+    confs = cfgs(3)
+    for c in confs:
+        c.step_impl = "pallas"
+    cluster = start_with(confs, mesh=mesh, batch_rows=64)
+    errors = []
+    admitted = {"strict": 0}
+    lock = threading.Lock()
+    LIMIT = 60
+
+    def mk(i):
+        kind = i % 3
+        if kind == 0:
+            return RateLimitRequest(name="psoak", unique_key="strict",
+                                    hits=1, limit=LIMIT,
+                                    duration=3_600_000)
+        if kind == 1:
+            return RateLimitRequest(name="psoak",
+                                    unique_key=f"lk{i % 19}", hits=1,
+                                    limit=10_000, duration=600_000,
+                                    algorithm=Algorithm.LEAKY_BUCKET)
+        return RateLimitRequest(name="psoak", unique_key=f"g{i % 7}",
+                                hits=1, limit=10_000, duration=600_000,
+                                behavior=Behavior.GLOBAL)
+
+    def worker(w):
+        addr = cluster.grpc_address(w % 3)
+        with Client(addr) as c:
+            for r in range(6):
+                reqs = [mk(w * 500 + r * 24 + i) for i in range(24)]
+                try:
+                    rs = c.get_rate_limits(reqs)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e))
+                    continue
+                with lock:
+                    for req, resp in zip(reqs, rs):
+                        if resp.error:
+                            errors.append(resp.error)
+                        elif (req.unique_key == "strict"
+                              and int(resp.status) == 0):
+                            admitted["strict"] += 1
+
+    try:
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        infos2 = [cluster.peer_at(0), cluster.peer_at(1)]
+        cluster.daemons[0].set_peers(infos2)
+        cluster.daemons[1].set_peers(infos2)
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:5]
+        # 7 workers x 6 rounds x 8 strict reqs = 336 attempts against
+        # capacity 60; churn may re-home the key once (reset or
+        # handover) so admitted lies in [LIMIT, 2*LIMIT]
+        assert LIMIT <= admitted["strict"] <= 2 * LIMIT, admitted
+    finally:
+        cluster.stop()

@@ -1332,7 +1332,10 @@ def _run_section(name, inline):
     # wave buckets; cluster/cfg5 one fresh shape each), so one wedged
     # section + the follow-up probe stays inside the watchdog's
     # whole-run deadline even on a cold cache (see _watchdog_main)
-    budgets = {"svc": 1500, "cluster": 1200, "cfg5": 1200}
+    # pallas: a cold Mosaic kernel compile (~220-305 s over the
+    # tunnel) + the fused occ/sat program + a 2 GiB table init
+    budgets = {"svc": 1500, "cluster": 1200, "cfg5": 1200,
+               "pallas": 1500}
     timeout = int(os.environ.get("GUBER_BENCH_SECTION_TIMEOUT",
                                  str(budgets.get(name, 900))))
     t0 = time.perf_counter()
@@ -1441,12 +1444,15 @@ def _watchdog_main():
     import subprocess
 
     # Budget: two cold headline compiles (~300 s each) + scan/link/
-    # latency + up to 8 section children, each paying backend init and
+    # latency + up to 9 section children (incl. the pallas serving
+    # row, its own cold Mosaic compile), each paying backend init and
     # possibly a cold compile (~250-330 s/section on a cold cache), and
-    # at most ONE wedged section (900-1200 s timeout + 150 s probe —
+    # at most ONE wedged section (900-1500 s timeout + 150 s probe —
     # after a failed probe the remaining device sections are skipped).
-    # Cold-cache worst case ≈ 600+400+8×330+1350 ≈ 5000 s; warm-cache
-    # runs finish in a fraction of that.
+    # Cold-cache worst case ≈ 600+400+9×330+1650 ≈ 5600 s — slightly
+    # over the 5400 s default, which is acceptable because every
+    # section checkpoints progressively (a late timeout costs the last
+    # row, not the run); warm-cache runs finish in a fraction of that.
     deadline = int(os.environ.get("GUBER_BENCH_TIMEOUT", "5400"))
     env = dict(os.environ, GUBER_BENCH_INNER="1")
     # per-run checkpoint file: a concurrent bench on the same host must

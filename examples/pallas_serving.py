@@ -10,6 +10,7 @@ errors, watched by the `gubernator_pallas_bucket_saturation` gauge.
 Run: python examples/pallas_serving.py   (CPU runs the kernel in
 interpret mode — correct but slow; the mode targets real TPUs.)
 """
+import os
 import time
 
 from gubernator_tpu.config import Config
@@ -18,6 +19,9 @@ from gubernator_tpu.types import RateLimitRequest
 
 
 def main() -> None:
+    # env beats Config in step_impl resolution — an exported
+    # GUBER_STEP_IMPL would silently demo the wrong engine
+    os.environ["GUBER_STEP_IMPL"] = "pallas"
     # sizing rule (example.conf): cache_size >= 2.5x peak live keys
     inst = V1Instance(Config(cache_size=1 << 14, step_impl="pallas",
                              sweep_interval_ms=0))

@@ -58,14 +58,18 @@ def global_mesh(axis: str = SHARD_AXIS) -> jax.sharding.Mesh:
     return jax.sharding.Mesh(np.asarray(jax.devices()), (axis,))
 
 
-def process_local_batch(mesh: jax.sharding.Mesh, host_cols, shape):
+def process_local_batch(mesh: jax.sharding.Mesh, host_cols, shape,
+                        spec=None):
     """Assemble a globally-sharded array from THIS process's slice
     (jax.make_array_from_process_local_data) — the multi-host analog of
     the single-host ``device_put(batch, NamedSharding(...))``: every
     daemon contributes the sub-batch for the shards it hosts.
+    ``spec`` overrides the default first-axis sharding (packed wire
+    lanes are [cols, B] — sharded on axis 1, P(None, shard)).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    sharding = NamedSharding(mesh, spec if spec is not None
+                             else P(SHARD_AXIS))
     return jax.make_array_from_process_local_data(sharding, host_cols,
                                                   shape)

@@ -67,8 +67,47 @@ state, outs, counters = step(state, dev_batch,
                              jnp.asarray(1_760_000_000_000, jnp.int64))
 over, ins = int(counters[0]), int(counters[1])
 assert ins == 4 * B // 2 * 2, ins  # every process's 2B keys inserted
+
+# the pallas (Mosaic-kernel) serving step over the SAME multi-host
+# mesh — the kernel mode's DCN-analog gate (interpret on CPU, same as
+# its off-TPU serving path).  Raw packed lanes: the engine's host
+# routing is single-process, but the device step is pure shard_map.
+from gubernator_tpu.ops import pallas_step as pstep_mod
+from gubernator_tpu.parallel.pallas_engine import make_pallas_step_packed
+
+CAPL = 1 << 8   # rows per shard
+PB = 32         # batch rows per shard
+pkstep = make_pallas_step_packed(mesh, interpret=True)
+rows = multihost.process_local_batch(
+    mesh, np.zeros((2 * CAPL, pstep_mod.WORDS), np.int32),
+    (4 * CAPL, pstep_mod.WORDS))
+NOWP = 1_760_000_000_000
+a64_host = np.zeros((8, 2 * PB), np.int64)
+rngp = np.random.default_rng(100 + proc_id)
+a64_host[0] = rngp.integers(1, 1 << 62, 2 * PB)  # key bits (nonzero)
+a64_host[1] = 1                                   # hits
+a64_host[2] = 5                                   # limit
+a64_host[3] = 60_000                              # duration
+a64_host[4] = 60_000                              # eff_ms
+a64_host[6] = 5                                   # burst
+a64_host[7] = NOWP                                # per-row now
+a32_host = np.zeros((3, 2 * PB), np.int32)
+a32_host[1][::2] = 1                              # half LEAKY
+a32_host[2] = 1                                   # all valid
+a64 = multihost.process_local_batch(mesh, a64_host, (8, 4 * PB),
+                                    spec=P(None, "shard"))
+a32 = multihost.process_local_batch(mesh, a32_host, (3, 4 * PB),
+                                    spec=P(None, "shard"))
+rows, packed, (pover, pins) = pkstep(
+    rows, a64, a32, jnp.asarray(NOWP, jnp.int64))
+assert int(pins) == 4 * PB, int(pins)  # every key inserted, all shards
+st_local = np.asarray(jax.device_get(
+    packed.addressable_shards[0].data))
+assert (st_local[0] == 0).all()        # fresh keys: UNDER_LIMIT
+assert (st_local[1] == 4).all()        # remaining = 5 - 1
+
 print(f"proc {proc_id} ok: psum fold + sharded step over 2 hosts, "
-      f"inserted={ins}")
+      f"inserted={ins}, pallas inserted={int(pins)}")
 """
 
 

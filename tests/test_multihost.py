@@ -82,18 +82,25 @@ rows = multihost.process_local_batch(
     mesh, np.zeros((2 * CAPL, pstep_mod.WORDS), np.int32),
     (4 * CAPL, pstep_mod.WORDS))
 NOWP = 1_760_000_000_000
-a64_host = np.zeros((8, 2 * PB), np.int64)
 rngp = np.random.default_rng(100 + proc_id)
-a64_host[0] = rngp.integers(1, 1 << 62, 2 * PB)  # key bits (nonzero)
-a64_host[1] = 1                                   # hits
-a64_host[2] = 5                                   # limit
-a64_host[3] = 60_000                              # duration
-a64_host[4] = 60_000                              # eff_ms
-a64_host[6] = 5                                   # burst
-a64_host[7] = NOWP                                # per-row now
-a32_host = np.zeros((3, 2 * PB), np.int32)
-a32_host[1][::2] = 1                              # half LEAKY
-a32_host[2] = 1                                   # all valid
+nreq = 2 * PB
+alg = np.zeros(nreq, np.int32)
+alg[::2] = 1  # half LEAKY
+from gubernator_tpu.core.batch import RequestBatch as RB
+from gubernator_tpu.parallel.sharded import pack_wave_host
+
+pbatch = RB(
+    key=rngp.integers(1, 1 << 62, nreq).astype(np.uint64),
+    hits=np.ones(nreq, np.int64),
+    limit=np.full(nreq, 5, np.int64),
+    duration=np.full(nreq, 60_000, np.int64),
+    eff_ms=np.full(nreq, 60_000, np.int64),
+    greg_end=np.zeros(nreq, np.int64),
+    behavior=np.zeros(nreq, np.int32), algorithm=alg,
+    burst=np.full(nreq, 5, np.int64),
+    valid=np.ones(nreq, bool),
+    now=np.full(nreq, NOWP, np.int64))
+a64_host, a32_host = pack_wave_host(pbatch)
 a64 = multihost.process_local_batch(mesh, a64_host, (8, 4 * PB),
                                     spec=P(None, "shard"))
 a32 = multihost.process_local_batch(mesh, a32_host, (3, 4 * PB),

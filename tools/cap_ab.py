@@ -8,8 +8,12 @@ battery's capacity sweep and bench will inherit the fix; if it still
 reads ~217 ms, the Pallas floor is the headline plan and the battery
 should still run (its duel covers all three modes).
 
-Usage: timeout 1200 python tools/cap_ab.py [log2cap]
+Usage: timeout 1200 python tools/cap_ab.py [log2cap] [--pallas]
+`--pallas` also times the Mosaic kernel at the SAME shape (one more
+compile) — the tier-3 answer if tiers 1-2 stay pathological.
 Writes /tmp/cap_ab.json; copy into artifacts/ and commit.
+GUBER_CAP_AB_ANY_BACKEND=1 + GUBER_JAX_PLATFORM=cpu runs an offline
+smoke (interpret-mode kernel) for plumbing checks.
 """
 import json
 import os
@@ -24,7 +28,15 @@ _jax_cache.setup()
 
 
 def main() -> int:
+    plat = os.environ.get("GUBER_JAX_PLATFORM", "")
     import jax
+
+    if plat:
+        # must go through jax.config: the sandbox sitecustomize
+        # overwrites the jax_platforms config at interpreter start (env
+        # is ignored) — and axon backend init can HANG when the relay
+        # is down, so the platform must be pinned before any jax use
+        jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
     import numpy as np
 
@@ -33,7 +45,11 @@ def main() -> int:
     from gubernator_tpu.core.step import decide_batch_donated
     from gubernator_tpu.core.table import init_table
 
-    log2cap = int(sys.argv[1]) if len(sys.argv) > 1 else 22
+    flags = [a for a in sys.argv[1:] if a.startswith("-")]
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    log2cap = int(pos[0]) if pos else 22
+    pallas_only = "--pallas-only" in flags
+    want_pallas = pallas_only or "--pallas" in flags
     cap, n_keys = 1 << log2cap, (1 << log2cap) // 2
     B = 65536
     i64 = jnp.int64
@@ -48,7 +64,8 @@ def main() -> int:
             json.dump(res, f, indent=1)
 
     dump()
-    if res["backend"] != "tpu":
+    smoke = os.environ.get("GUBER_CAP_AB_ANY_BACKEND") == "1"
+    if res["backend"] != "tpu" and not smoke:
         res["abort"] = "not tpu"
         dump()
         return 1
@@ -70,33 +87,89 @@ def main() -> int:
     now0 = jnp.asarray(NOW, i64)
     bump(now0).block_until_ready()
 
-    st = init_table(cap)
     batches = [mk(keyhash((rng.zipf(1.1, size=B) % n_keys)
                           .astype(np.uint64))) for _ in range(4)]
-    t = time.time()
-    st, out = decide_batch_donated(st, batches[0], now0)
-    out.status.block_until_ready()
-    res["compile_s"] = round(time.time() - t, 1)
-    dump()
     ids = np.arange(n_keys, dtype=np.uint64)
-    for a in range(0, n_keys, B):
-        st, out = decide_batch_donated(
-            st, mk(keyhash(pad_chunk(ids[a:a + B], B))), now0)
-    out.status.block_until_ready()
-    now_dev = bump(now0)
     reps = 32
-    t = time.time()
-    for r in range(reps):
-        st, out = decide_batch_donated(st, batches[r % 4], now_dev)
-        now_dev = bump(now_dev)
-    out.status.block_until_ready()
-    dt = time.time() - t
-    res["ms_per_step"] = round(dt / reps * 1e3, 3)
-    res["decisions_per_s"] = round(reps * B / dt)
-    res["verdict"] = ("FIXED" if dt / reps < 0.01 else
-                      "still pathological" if dt / reps > 0.05 else
-                      "improved")
-    dump()
+    now_dev = bump(now0)
+    if not pallas_only:  # --pallas-only skips the XLA arm: in the
+        # escalation ladder tier 1 was already measured twice by the
+        # time tier 3 fires, and every extra minute on the wedge-prone
+        # tunnel risks the one number this stage exists to capture
+        st = init_table(cap)
+        t = time.time()
+        st, out = decide_batch_donated(st, batches[0], now0)
+        out.status.block_until_ready()
+        res["compile_s"] = round(time.time() - t, 1)
+        dump()
+        for a in range(0, n_keys, B):
+            st, out = decide_batch_donated(
+                st, mk(keyhash(pad_chunk(ids[a:a + B], B))), now0)
+        out.status.block_until_ready()
+        t = time.time()
+        for r in range(reps):
+            st, out = decide_batch_donated(st, batches[r % 4], now_dev)
+            now_dev = bump(now_dev)
+        out.status.block_until_ready()
+        dt = time.time() - t
+        res["ms_per_step"] = round(dt / reps * 1e3, 3)
+        res["decisions_per_s"] = round(reps * B / dt)
+        res["err_fraction"] = round(
+            float(np.asarray(out.err).mean()), 6)
+        res["verdict"] = ("FIXED" if dt / reps < 0.01 else
+                          "still pathological" if dt / reps > 0.05 else
+                          "improved")
+        dump()
+        del st
+
+    # --pallas: also time the Mosaic kernel at the SAME shape — the
+    # tier-3 answer (serve large CAP from the kernel) in the same
+    # window, one extra compile.  The kernel owns its scatters, so its
+    # number is independent of how the backend lowers a CAP-row XLA
+    # scatter — if tier 1 and tier 2 both stay pathological, this is
+    # the serving plan's throughput floor at the flagship shape.
+    if want_pallas:
+        try:
+            from functools import partial
+
+            from gubernator_tpu.ops.pallas_step import (
+                decide_batch_pallas, init_pallas_table)
+
+            if res["backend"] != "tpu":
+                # off-TPU: interpret mode, like the extras stage —
+                # keyed on the BACKEND, not the smoke env var, so a
+                # stale smoke export on a real TPU run can never
+                # record interpret numbers as the serving floor
+                decide_batch_pallas = partial(decide_batch_pallas,
+                                              interpret=True)
+            pt = init_pallas_table(cap * 2)  # bucket layout, load /2
+            t = time.time()
+            pt, pout = decide_batch_pallas(pt, batches[0], now0)
+            pout.status.block_until_ready()
+            res["pallas_compile_s"] = round(time.time() - t, 1)
+            dump()
+            for a in range(0, n_keys, B):
+                pt, pout = decide_batch_pallas(
+                    pt, mk(keyhash(pad_chunk(ids[a:a + B], B))), now0)
+            pout.status.block_until_ready()
+            now_dev = bump(now_dev)
+            t = time.time()
+            for r in range(reps):
+                pt, pout = decide_batch_pallas(pt, batches[r % 4],
+                                               now_dev)
+                now_dev = bump(now_dev)
+            pout.status.block_until_ready()
+            pdt = time.time() - t
+            res["pallas_ms_per_step"] = round(pdt / reps * 1e3, 3)
+            res["pallas_decisions_per_s"] = round(reps * B / pdt)
+            # errs = bucket-overflow inserts etc.; without this the
+            # floor number could hide cheaper error-path steps
+            res["pallas_err_fraction"] = round(
+                float(np.asarray(pout.err).mean()), 6)
+        except Exception as e:  # noqa: BLE001
+            res["pallas_error"] = str(e)[:400]
+        dump()
+
     print(json.dumps(res))
     return 0
 

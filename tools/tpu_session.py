@@ -126,6 +126,12 @@ def run_stage(name, argv, timeout, env_extra=None, progress_file=None,
     grandchild would silently hold the single-client tunnel and starve
     every later stage."""
     env = dict(os.environ)
+    # a live battery must measure the REAL backend: stale offline-smoke
+    # exports (cpu pin + any-backend gate) would silently run the whole
+    # escalation ladder on CPU and steer tiers 2/3 off a CPU verdict
+    for stale in ("GUBER_CAP_AB_ANY_BACKEND", "GUBER_JAX_PLATFORM"):
+        if stale not in (env_extra or {}):
+            env.pop(stale, None)
     env.update(env_extra or {})
     t0 = time.time()
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE, cwd=_REPO,
@@ -233,6 +239,7 @@ def main() -> int:
     # scatters at the 2^21 operand size that lowers well) — one more
     # compile answers whether it is the large-CAP serving mode.
     verdict = (results.get("cap_ab22") or {}).get("verdict", "")
+    ks_verdict = ""
     if ok and verdict == "still pathological":
         t_ks = time.time()
         run_stage("cap_ab22_ksplit", [sys.executable,
@@ -243,6 +250,25 @@ def main() -> int:
         merge_json_file("cap_ab22_ksplit", "/tmp/cap_ab.json", t_ks)
         if not relay_alive():
             record("abort", "relay died during cap_ab ksplit")
+            return 1
+        ks_verdict = (results.get("cap_ab22_ksplit") or {}).get(
+            "verdict", "")
+    # 2c. tier 3: unless SOME XLA tier verifiably fixed it, time the
+    # Mosaic kernel at the same shape — the serving floor the
+    # escalation ladder terminates in.  Gate on a good verdict
+    # existing, not on a bad one: a stage that died/timed out without
+    # writing any verdict (ok=False, verdict='') is exactly the
+    # degraded window where the tier-3 number matters most.
+    # --pallas-only skips the XLA arm tiers 1-2 already measured.
+    if not {verdict, ks_verdict} & {"FIXED", "improved"}:
+        t_p = time.time()
+        run_stage("cap_ab22_pallas", [sys.executable,
+                                      os.path.join(_HERE, "cap_ab.py"),
+                                      "22", "--pallas-only"],
+                  timeout=1800, progress_file="/tmp/cap_ab.json")
+        merge_json_file("cap_ab22_pallas", "/tmp/cap_ab.json", t_p)
+        if not relay_alive():
+            record("abort", "relay died during cap_ab pallas")
             return 1
 
     # 3. THE DRIVER-SHAPED BENCH — before any exploratory stage.  The

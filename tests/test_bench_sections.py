@@ -36,6 +36,19 @@ def test_section_child_writes_rows(tmp_path):
         assert v.get("decisions_per_s", 0) > 0, rows
 
 
+def test_pallas_section_child_writes_row(tmp_path):
+    """The step_impl=pallas serving row (11_pallas_serving) through the
+    driver's real child protocol; a hostile GUBER_STEP_IMPL export must
+    not flip the engine under measurement."""
+    rows = _run_section("pallas", tmp_path, timeout=420,
+                        extra_env={"GUBER_STEP_IMPL": "xla"})
+    r = rows["11_pallas_serving"]
+    assert r["wire_lane_decisions_per_s"] > 0
+    assert r["cpu_interpret_reduced"] is True
+    assert r["svc_p99_ms"] > 0
+    assert "INTERPRET" in r["context"]
+
+
 def test_section_child_backend_mismatch_guard(tmp_path):
     """A child that lands on a different backend than the parent
     expected must produce an error row, not mislabeled numbers."""

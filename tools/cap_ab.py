@@ -28,15 +28,12 @@ _jax_cache.setup()
 
 
 def main() -> int:
-    plat = os.environ.get("GUBER_JAX_PLATFORM", "")
-    import jax
+    # before any jax use: axon backend init can HANG when the relay is
+    # down, so an offline smoke must pin the platform first
+    from gubernator_tpu.cmd import maybe_pin_platform
 
-    if plat:
-        # must go through jax.config: the sandbox sitecustomize
-        # overwrites the jax_platforms config at interpreter start (env
-        # is ignored) — and axon backend init can HANG when the relay
-        # is down, so the platform must be pinned before any jax use
-        jax.config.update("jax_platforms", plat)
+    maybe_pin_platform()
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -47,6 +44,13 @@ def main() -> int:
 
     flags = [a for a in sys.argv[1:] if a.startswith("-")]
     pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    unknown = set(flags) - {"--pallas", "--pallas-only"}
+    if unknown:
+        # a silently-ignored typo would burn a live tunnel window
+        # WITHOUT the measurement the operator asked for
+        print(f"unknown flag(s): {sorted(unknown)} "
+              "(known: --pallas, --pallas-only)", file=sys.stderr)
+        return 2
     log2cap = int(pos[0]) if pos else 22
     pallas_only = "--pallas-only" in flags
     want_pallas = pallas_only or "--pallas" in flags

@@ -126,10 +126,16 @@ def run_stage(name, argv, timeout, env_extra=None, progress_file=None,
     grandchild would silently hold the single-client tunnel and starve
     every later stage."""
     env = dict(os.environ)
-    # a live battery must measure the REAL backend: stale offline-smoke
-    # exports (cpu pin + any-backend gate) would silently run the whole
-    # escalation ladder on CPU and steer tiers 2/3 off a CPU verdict
-    for stale in ("GUBER_CAP_AB_ANY_BACKEND", "GUBER_JAX_PLATFORM"):
+    # a live battery must measure the REAL backend at the REAL shapes
+    # in the REAL serving mode: stale operator exports would silently
+    # corrupt it — cpu pin / any-backend gate run the escalation
+    # ladder on CPU; KSPLIT makes the tier-1/tier-2 A/B identical;
+    # EXTRAS_SMOKE runs the extras at toy shapes; STEP_IMPL flips the
+    # engine under every bench row except 11_pallas_serving.  A stage
+    # that NEEDS one of these sets it via env_extra.
+    for stale in ("GUBER_CAP_AB_ANY_BACKEND", "GUBER_JAX_PLATFORM",
+                  "GUBER_KSPLIT", "GUBER_EXTRAS_SMOKE",
+                  "GUBER_STEP_IMPL"):
         if stale not in (env_extra or {}):
             env.pop(stale, None)
     env.update(env_extra or {})

@@ -74,6 +74,14 @@ NOW0 = 1_760_000_000_000
 TARGET = 50e6
 
 
+def _host_cores() -> int:
+    """Schedulable cores for THIS process (affinity-aware where the
+    platform supports it)."""
+    return (len(os.sched_getaffinity(0))
+            if hasattr(os, "sched_getaffinity")
+            else (os.cpu_count() or 1))
+
+
 def _keyhash(x: np.ndarray) -> np.ndarray:
     """Key-id → 64-bit hash (stand-in for host string hashing, which is
     not what this benchmark measures — see extra.host_hash_mkeys).
@@ -884,8 +892,7 @@ def _sec_cluster():
             lane="wire_clustered")._value.get()
         row = {"decisions_per_s": round(dps_c3), "daemons": 3,
                "wire_clustered_requests": int(lane)}
-        cores = len(os.sched_getaffinity(0)) if hasattr(
-            os, "sched_getaffinity") else (os.cpu_count() or 1)
+        cores = _host_cores()
         if cores < 3:
             # VERDICT r2 weak #3: without this, the row reads as a
             # regression vs the single-daemon row
@@ -994,8 +1001,7 @@ def _group_contention_probe(n_procs: int, reps_g: int) -> dict:
         if flat:
             row["contention_p99_ms"] = round(
                 float(np.percentile(flat, 99)), 3)
-            cores = len(os.sched_getaffinity(0)) if hasattr(
-                os, "sched_getaffinity") else (os.cpu_count() or 1)
+            cores = _host_cores()
             if cores < n_procs + 1:
                 # r3→r4 this row swung 951 → 10,487 ms on the same
                 # probe: on a starved host the percentile is scheduler
@@ -1026,8 +1032,7 @@ def _sec_group():
     share the TPU chip; on a TPU host these are the ingest workers).
     Needs ≥4 host cores — on fewer the row self-skips honestly
     (measured 1-core thrash: 18k/s aggregate, p99 25 s)."""
-    host_cores = len(os.sched_getaffinity(0)) if hasattr(
-        os, "sched_getaffinity") else (os.cpu_count() or 1)
+    host_cores = _host_cores()
     if os.environ.get("GUBER_BENCH_SKIP_GROUP"):
         return {}
     if host_cores < 4:

@@ -20,8 +20,10 @@ from gubernator_tpu.types import RateLimitRequest
 
 def main() -> None:
     # env beats Config in step_impl resolution — an exported
-    # GUBER_STEP_IMPL would silently demo the wrong engine
-    os.environ["GUBER_STEP_IMPL"] = "pallas"
+    # GUBER_STEP_IMPL would silently demo the wrong engine.  POP, not
+    # set: this also runs via runpy inside the test process, where a
+    # lingering export would flip the engine under every later test.
+    os.environ.pop("GUBER_STEP_IMPL", None)
     # sizing rule (example.conf): cache_size >= 2.5x peak live keys
     inst = V1Instance(Config(cache_size=1 << 14, step_impl="pallas",
                              sweep_interval_ms=0))

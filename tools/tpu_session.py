@@ -135,9 +135,16 @@ def run_stage(name, argv, timeout, env_extra=None, progress_file=None,
     # that NEEDS one of these sets it via env_extra.
     for stale in ("GUBER_CAP_AB_ANY_BACKEND", "GUBER_JAX_PLATFORM",
                   "GUBER_KSPLIT", "GUBER_EXTRAS_SMOKE",
-                  "GUBER_STEP_IMPL"):
-        if stale not in (env_extra or {}):
-            env.pop(stale, None)
+                  "GUBER_STEP_IMPL", "GUBER_BENCH_FAST",
+                  "GUBER_PROBES", "GUBER_BENCH_B"):
+        if stale not in (env_extra or {}) and env.pop(stale, None) \
+                is not None:
+            # observable: an operator who exported one ON PURPOSE must
+            # see the battery discarded it, not publish numbers for a
+            # mode they never measured
+            print(f"[{name}] scrubbed stale env {stale} (stage envs "
+                  "are canonical; pass via env_extra in the script to "
+                  "override)", file=sys.stderr)
     env.update(env_extra or {})
     t0 = time.time()
     proc = subprocess.Popen(argv, stdout=subprocess.PIPE, cwd=_REPO,

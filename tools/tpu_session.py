@@ -344,9 +344,18 @@ def extras() -> int:
 
     out_path = os.environ.get("GUBER_SESSION_EXTRAS_OUT",
                               "/tmp/tpu_session_extras.json")
+    #: GUBER_EXTRAS_SMOKE: run every stage at toy shapes on any backend
+    #: (offline dry-run of the battery code).  ONE boolean for every
+    #: smoke gate below — mismatched truthiness (e.g. "=true" passing
+    #: one gate, failing another) must not mix toy rows with real paths
+    smoke = bool(os.environ.get("GUBER_EXTRAS_SMOKE"))
     #: second progressive mirror in the repo workspace: the extras rows
-    #: survive on disk even if the orchestrator dies before its merge
-    mirror = os.path.join(_REPO, "artifacts", "tpu_session_extras_live.json")
+    #: survive on disk even if the orchestrator dies before its merge.
+    #: A SMOKE run must not touch it — toy-shape CPU rows in the repo
+    #: mirror read like (or overwrite) a real session's record.
+    mirror = ("/tmp/tpu_session_extras_smoke_mirror.json" if smoke
+              else os.path.join(_REPO, "artifacts",
+                                "tpu_session_extras_live.json"))
     ex: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
 
     def rec(key, value):
@@ -371,10 +380,6 @@ def extras() -> int:
     from gubernator_tpu.core.step import decide_batch, decide_batch_donated
     from gubernator_tpu.core.table import init_table
 
-    #: GUBER_EXTRAS_SMOKE: run every stage at toy shapes on any backend
-    #: (offline dry-run of the battery code — a typo here would
-    #: otherwise burn a live tunnel window)
-    smoke = bool(os.environ.get("GUBER_EXTRAS_SMOKE"))
     if jax.default_backend() != "tpu" and not smoke:
         rec("abort", f"backend {jax.default_backend()}")
         return 1

@@ -1,0 +1,43 @@
+#!/bin/sh
+# Poll the axon relay; the MOMENT it accepts, fire the full battery
+# (tools/tpu_session.py: probe -> cap_ab ladder -> bench -> extras),
+# whose results mirror progressively into artifacts/.
+#
+# Usage:  nohup sh tools/relay_watch_and_fire.sh >/tmp/relay_fire.log 2>&1 &
+#
+# SINGLE-CLIENT RULE: the tunnel serves ONE device client.  If this
+# script fires, do NOT start another jax process until it finishes —
+# and never kill it mid-compile (the known permanent wedge mechanism).
+# The battery itself enforces stage ordering and artifact mirroring.
+#
+# Bounded: gives up after ~24 h of polling so it cannot outlive its
+# usefulness; tpu_session carries its own 4 h budget.
+# fail LOUDLY if the probe interpreter is missing — otherwise a broken
+# python would read as "relay down" for 24 silent hours
+command -v python >/dev/null 2>&1 || {
+  echo "python not found on PATH - cannot probe the relay" >&2
+  exit 2
+}
+tries=0
+while [ "$tries" -lt 1440 ]; do
+  if python - <<'EOF'
+import socket, sys
+s = socket.socket()
+s.settimeout(3)
+try:
+    s.connect(("127.0.0.1", 8103))
+except Exception:
+    sys.exit(1)
+finally:
+    s.close()
+EOF
+  then
+    echo "relay alive at $(date -u +%H:%M:%S) - firing battery"
+    cd "$(dirname "$0")/.." || exit 1
+    exec timeout 14400 python tools/tpu_session.py
+  fi
+  tries=$((tries + 1))
+  sleep 60
+done
+echo "relay never returned within the watch window"
+exit 1

@@ -342,17 +342,29 @@ def extras() -> int:
 
     _jax_cache.setup()
 
-    out_path = os.environ.get("GUBER_SESSION_EXTRAS_OUT",
-                              "/tmp/tpu_session_extras.json")
     #: GUBER_EXTRAS_SMOKE: run every stage at toy shapes on any backend
     #: (offline dry-run of the battery code).  ONE boolean for every
     #: smoke gate below — mismatched truthiness (e.g. "=true" passing
-    #: one gate, failing another) must not mix toy rows with real paths
-    smoke = bool(os.environ.get("GUBER_EXTRAS_SMOKE"))
-    #: second progressive mirror in the repo workspace: the extras rows
-    #: survive on disk even if the orchestrator dies before its merge.
-    #: A SMOKE run must not touch it — toy-shape CPU rows in the repo
-    #: mirror read like (or overwrite) a real session's record.
+    #: one gate, failing another) must not mix toy rows with real
+    #: paths — and "=0"/"=false" mean OFF, not "non-empty ⇒ on".
+    smoke_raw = os.environ.get("GUBER_EXTRAS_SMOKE", "").lower()
+    smoke = smoke_raw in ("1", "true", "yes", "on")
+    if smoke_raw and not smoke and smoke_raw not in ("0", "false",
+                                                     "no", "off"):
+        print(f"GUBER_EXTRAS_SMOKE={smoke_raw!r} not understood "
+              "(want 1/true/yes/on or 0/false/no/off)",
+              file=sys.stderr)
+        return 2
+    #: BOTH progressive outputs divert for smoke runs: the repo mirror
+    #: (toy rows read like — or overwrite — a real session's record)
+    #: AND the fixed /tmp checkpoint (a smoke concurrent with a live
+    #: battery would otherwise pass merge_json_file's freshness check
+    #: and publish toy rows as the live session's extras, while its
+    #: mtime updates defeat the live stage's stall detection).
+    out_path = os.environ.get(
+        "GUBER_SESSION_EXTRAS_OUT",
+        "/tmp/tpu_session_extras_smoke.json" if smoke
+        else "/tmp/tpu_session_extras.json")
     mirror = ("/tmp/tpu_session_extras_smoke_mirror.json" if smoke
               else os.path.join(_REPO, "artifacts",
                                 "tpu_session_extras_live.json"))

@@ -37,9 +37,9 @@ def _stream(seed, n_batches=4, batch=96, n_keys=40):
     return out
 
 
-def _run(mesh_n, stream):
-    eng = ShardedEngine(make_mesh(n=mesh_n), capacity_per_shard=1 << 10,
-                        batch_per_shard=64)
+def _run(mesh_n, stream, engine_cls=ShardedEngine):
+    eng = engine_cls(make_mesh(n=mesh_n), capacity_per_shard=1 << 10,
+                     batch_per_shard=64)
     results = []
     for reqs, now in stream:
         results.extend((int(r.status), r.remaining, r.reset_time, r.limit)
@@ -65,6 +65,24 @@ def test_shard_count_does_not_change_decisions():
     r1, _ = _run(1, s)
     r4, _ = _run(4, s)
     assert r1 == r4
+
+
+def test_pallas_mode_is_deterministic_and_layout_independent():
+    """The same contract for step_impl=pallas: identical streams →
+    bit-identical decisions AND table words; and the kernel engine
+    agrees with the XLA engine decision-for-decision on the stream
+    (the serving mode is a layout choice, not a semantic).  Domain
+    note: _stream's limits/durations all sit inside the kernel's
+    value bounds, so no row is domain-dropped here."""
+    from gubernator_tpu.parallel.pallas_engine import PallasServingEngine
+
+    s = _stream(13)
+    r1, e1 = _run(2, s, engine_cls=PallasServingEngine)
+    r2, e2 = _run(2, s, engine_cls=PallasServingEngine)
+    assert r1 == r2
+    assert (np.asarray(e1.state) == np.asarray(e2.state)).all()
+    rx, _ = _run(2, s)
+    assert r1 == rx
 
 
 def test_concurrent_clients_conserve_hits():

@@ -40,30 +40,28 @@ def log(*a):
 
 
 FAST = bool(os.environ.get("GUBER_BENCH_FAST"))
-#: north star is 10M keys; CAP 2^25 (load 0.30) + a 16-slot probe
-#: window is the empirically-verified zero-loss flagship shape: the
-#: EXACT 10M-key populate inserts every key (0 errs; at the former
-#: CAP 2^24/8-probe shape 17,739 keys lost every claim round and
-#: ~4e-4 of steady-state requests were unservable — VERDICT r3
-#: item 9).  The CPU fallback (GUBER_BENCH_FAST) shrinks the workload
-#: — its config string says so; it never silently stands in for the
-#: 10M-key number.
+#: north star is 10M keys; CAP 2^26 (load 0.149) + the default 8-slot
+#: probe window is the zero-loss flagship shape as of round 5: the
+#: EXACT 10M-key populate inserts every key (0 errs,
+#: tools/populate_errs_check.py; CAP 2^25/8-probe loses 71 keys and
+#: the former CAP 2^24/8-probe shape lost 17,739 — VERDICT r3 item 9).
+#: The r4 16-probe widening is GONE because the 2026-08-02 backend
+#: compiler serializes 16-probe steps at CAP >= 2^25 (0.35M dec/s
+#: on-chip, artifacts/tpu_session_r5_attempt1.json) while 8-probe
+#: shapes lower well clear up to CAP 2^27 (564.7M dec/s, cfg5 row in
+#: the same artifact) — doubling CAP instead of the probe window buys
+#: zero-loss WITHOUT the pathological lowering.  The CPU fallback
+#: (GUBER_BENCH_FAST) shrinks the workload — its config string says
+#: so; it never silently stands in for the 10M-key number.
 N_KEYS = int(os.environ.get("GUBER_BENCH_KEYS",
                             1_000_000 if FAST else 10_000_000))
-CAP = int(os.environ.get("GUBER_BENCH_CAP", 1 << 21 if FAST else 1 << 25))
-#: widen the probe window for the flagship shape only: sections and
-#: the FAST fallback model general serving at the default window
-#: (engines auto-grow their load down, so 8 probes lose nothing
-#: there).  Must be set before gubernator_tpu.core.step is imported.
-#: The marker env distinguishes "bench defaulted this" from "operator
-#: set this" across the watchdog → inner-bench → section process tree
-#: (a bare not-in-environ check would mistake the inherited default
-#: for an operator choice one process down).
-_PROBES_DEFAULTED = ("GUBER_PROBES" not in os.environ
-                     or bool(os.environ.get("GUBER_PROBES_BENCH_DEFAULT")))
-if not FAST and _PROBES_DEFAULTED:
-    os.environ["GUBER_PROBES"] = "16"
-    os.environ["GUBER_PROBES_BENCH_DEFAULT"] = "1"
+CAP = int(os.environ.get("GUBER_BENCH_CAP", 1 << 21 if FAST else 1 << 26))
+#: the probe window stays at the serving default (8) everywhere since
+#: round 5 — bench no longer exports a probe override; GUBER_PROBES in
+#: the environment therefore always means an operator choice, which
+#: sections propagate untouched (when absent they pin children to the
+#: serving default explicitly).
+_PROBES_DEFAULTED = "GUBER_PROBES" not in os.environ
 #: device batch = coalesced client batches of 1024 (GUBER_BENCH_B
 #: overrides for batch-size sweeps)
 B = int(os.environ.get("GUBER_BENCH_B", 8192 if FAST else 65536))
@@ -701,7 +699,7 @@ def _sec_cfg12():
         dps1, _ = _sustain(decide_batch, jnp, st, [b], 20, NOW0 + 1)
         out["1_single_key_smoke"] = {"decisions_per_s": round(dps1)}
     except Exception as e:  # noqa: BLE001
-        out["1_single_key_smoke"] = {"error": str(e)[:200]}
+        out["1_single_key_smoke"] = {"error": (str(e) or repr(e))[:200]}
     try:
         keys2 = _keyhash(rng.integers(0, 1000, size=Bs).astype(np.uint64))
         st = init_table(1 << 12)
@@ -714,7 +712,7 @@ def _sec_cfg12():
         dps2, _ = _sustain(decide_batch, jnp, st, [b2], 20, NOW0 + 1)
         out["2_leaky_1k_keys"] = {"decisions_per_s": round(dps2)}
     except Exception as e:  # noqa: BLE001
-        out["2_leaky_1k_keys"] = {"error": str(e)[:200]}
+        out["2_leaky_1k_keys"] = {"error": (str(e) or repr(e))[:200]}
     return out
 
 
@@ -810,7 +808,7 @@ def _sec_svc():
             out["6_service_path"]["svc_p99_ms"] = round(
                 float(np.percentile(lat, 99)), 3)
         except Exception as e:  # noqa: BLE001
-            out["6_service_path"]["wire_lane_error"] = str(e)[:200]
+            out["6_service_path"]["wire_lane_error"] = (str(e) or repr(e))[:200]
         # concurrent front door: 16 caller threads through the full
         # wire lane — the dispatcher coalesces them into shared waves
         try:
@@ -836,7 +834,7 @@ def _sec_svc():
             out["6_service_path"]["concurrent16_decisions_per_s"] = round(
                 n_threads * reps_c * 1000 / (time.perf_counter() - t0))
         except Exception as e:  # noqa: BLE001
-            out["6_service_path"]["concurrent_error"] = str(e)[:200]
+            out["6_service_path"]["concurrent_error"] = (str(e) or repr(e))[:200]
         # peer-forwarding path: what the owner-side apply of a
         # forwarded batch takes, via its wire lane
         try:
@@ -858,7 +856,7 @@ def _sec_svc():
                     reps * 1000 / (time.perf_counter() - t0)),
                 "batch": 1000}
         except Exception as e:  # noqa: BLE001
-            out["8_peer_path"] = {"error": str(e)[:200]}
+            out["8_peer_path"] = {"error": (str(e) or repr(e))[:200]}
     finally:
         inst.close()
     return out
@@ -943,7 +941,7 @@ def _group_contention_probe(n_procs: int, reps_g: int) -> dict:
                     calls[t](gdatas[(t + r) % 4], timeout=120)
                     lat[t].append((time.perf_counter() - t1) * 1e3)
             except Exception as e:  # noqa: BLE001
-                errors.append(str(e)[:120])
+                errors.append((str(e) or repr(e))[:120])
 
         ths = [_th.Thread(target=_w, args=(t,)) for t in range(n_chan)]
         t0 = time.perf_counter()
@@ -990,7 +988,7 @@ def _group_contention_probe(n_procs: int, reps_g: int) -> dict:
             conserved = (int(q.responses[0].remaining)
                          == 10**6 - 3 * n_chan)
         except Exception as e:  # noqa: BLE001
-            conserved = f"check failed: {str(e)[:120]}"
+            conserved = f"check failed: {(str(e) or repr(e))[:120]}"
         row = {f"contention_{n_procs}proc_decisions_per_s": round(
             len(flat) * 1000 / wall),
             "contention_completed_calls": len(flat),
@@ -1054,7 +1052,7 @@ def _sec_group():
         try:
             row.update(_group_contention_probe(n_procs=2, reps_g=8))
         except Exception as e:  # noqa: BLE001
-            row["contention_error"] = str(e)[:200]
+            row["contention_error"] = (str(e) or repr(e))[:200]
         return {"10_reuseport_group": row}
     import threading as _th
 
@@ -1201,7 +1199,7 @@ def _sec_cfg5():
                                       "capacity": cap5,
                                       "cpu_reduced": cpu5}}
     except Exception as e:  # noqa: BLE001
-        return {"5_gregorian_churn": {"error": str(e)[:200],
+        return {"5_gregorian_churn": {"error": (str(e) or repr(e))[:200],
                                       "capacity_attempted": int(cap5)}}
 
 
@@ -1331,30 +1329,41 @@ def _run_section(name, inline):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001
-            return {"error": f"{name}: {str(e)[:300]}"}
+            # str() of TimeoutError/queue.Empty is "" — always keep
+            # the type so the recorded row can be diagnosed
+            return {"error": f"{name}: {(str(e) or repr(e))[:300]}"}
     import subprocess
 
     path = f"/tmp/guber_section.{os.getpid()}.{name}.json"
     env = dict(os.environ, GUBER_BENCH_SECTION=name,
                GUBER_BENCH_SECTION_OUT=path)
     env.pop("GUBER_BENCH_INNER", None)
+    # a cold wave compile through the tunnel is 250-305 s: callers that
+    # arrive before warmup (the section's first request IS the warmup)
+    # must outwait it, or the whole section dies as an empty
+    # TimeoutError at 120 s (round-5 live window, sections 6/8/9)
+    env.setdefault("GUBER_RESULT_TIMEOUT_S", "900")
     if _PROBES_DEFAULTED:
-        # sections model general serving: default probe window (the
-        # 16-probe widening is the flagship populate shape's, set at
-        # module import — don't let children inherit it)
+        # sections model general serving at the serving default; since
+        # round 5 the flagship uses the same window, but pinning the
+        # children keeps operator GUBER_PROBES choices (the only other
+        # way the env can be set) explicit end-to-end
         env["GUBER_PROBES"] = "8"
-        env.pop("GUBER_PROBES_BENCH_DEFAULT", None)
     if _EXPECT_BACKEND:
         env["GUBER_BENCH_EXPECT_BACKEND"] = _EXPECT_BACKEND
-    # worst observed tunnel compile is ~305 s; budgets give 3× margin
-    # per cold compile a section legitimately needs (svc compiles BOTH
-    # wave buckets; cluster/cfg5 one fresh shape each), so one wedged
-    # section + the follow-up probe stays inside the watchdog's
-    # whole-run deadline even on a cold cache (see _watchdog_main)
-    # pallas: a cold Mosaic kernel compile (~220-305 s over the
-    # tunnel) + the fused occ/sat program + a 2 GiB table init
-    budgets = {"svc": 1500, "cluster": 1200, "cfg5": 1200,
-               "pallas": 1500}
+    # worst observed tunnel compile is ~305 s; budgets give margin per
+    # cold compile a section legitimately needs (svc compiles BOTH
+    # wave buckets; cluster/cfg5 one fresh shape each) PLUS one full
+    # 900 s dispatcher wave-wait (GUBER_RESULT_TIMEOUT_S above): a
+    # wedged wave must surface as that caller's TimeoutError row, not
+    # as this subprocess timeout killing the section's already-written
+    # lanes before _section_main's atomic write.  One such section +
+    # the follow-up probe still fits the watchdog's whole-run deadline
+    # (see _watchdog_main).  pallas: a cold Mosaic kernel compile
+    # (~220-305 s over the tunnel) + the fused occ/sat program + a
+    # 2 GiB table init + the same wave-wait.
+    budgets = {"svc": 2400, "cluster": 2100, "cfg5": 1200,
+               "pallas": 2400}
     timeout = int(os.environ.get("GUBER_BENCH_SECTION_TIMEOUT",
                                  str(budgets.get(name, 900))))
     t0 = time.perf_counter()
@@ -1373,7 +1382,7 @@ def _run_section(name, inline):
         return {"error": f"section timed out after {timeout}s "
                          "(wedged device compile?)"}
     except Exception as e:  # noqa: BLE001
-        return {"error": f"{name}: {str(e)[:300]}"}
+        return {"error": f"{name}: {(str(e) or repr(e))[:300]}"}
     finally:
         try:
             os.remove(path)
@@ -1404,7 +1413,7 @@ def _section_main():
         try:
             rows = fn()
         except Exception as e:  # noqa: BLE001
-            rows = {"error": f"{name}: {str(e)[:300]}"}
+            rows = {"error": f"{name}: {(str(e) or repr(e))[:300]}"}
     path = os.environ["GUBER_BENCH_SECTION_OUT"]
     with open(path + ".tmp", "w") as f:
         json.dump(rows, f)
@@ -1466,12 +1475,16 @@ def _watchdog_main():
     # latency + up to 9 section children (incl. the pallas serving
     # row, its own cold Mosaic compile), each paying backend init and
     # possibly a cold compile (~250-330 s/section on a cold cache), and
-    # at most ONE wedged section (900-1500 s timeout + 150 s probe —
+    # at most ONE wedged section (900-2400 s timeout + 150 s probe —
     # after a failed probe the remaining device sections are skipped).
-    # Cold-cache worst case ≈ 600+400+9×330+1650 ≈ 5600 s — slightly
-    # over the 5400 s default, which is acceptable because every
-    # section checkpoints progressively (a late timeout costs the last
-    # row, not the run); warm-cache runs finish in a fraction of that.
+    # Cold-cache worst case with a wedged svc section ≈
+    # 600+400+9×330+2550 ≈ 6500 s — over the 5400 s default, which is
+    # acceptable because every section checkpoints progressively (the
+    # deadline then salvages everything measured so far and costs only
+    # the wedged tail, exactly like a wedged link); the default can't
+    # grow without breaking the session-stage coupling (stage timeout
+    # 7800 s must cover deadline + the 1800 s CPU fallback).  Warm-
+    # cache runs finish in a fraction of the budget.
     deadline = int(os.environ.get("GUBER_BENCH_TIMEOUT", "5400"))
     env = dict(os.environ, GUBER_BENCH_INNER="1")
     # per-run checkpoint file: a concurrent bench on the same host must
@@ -1531,9 +1544,8 @@ def _watchdog_main():
                     "GUBER_BENCH_FAST": "1",
                     "GUBER_BENCH_SCAN": "4"}
         if _PROBES_DEFAULTED:
-            # the parent already exported the flagship's 16-probe
-            # widening; the FAST shape (1M keys / CAP 2^21, load 0.48)
-            # serves 100% at the default window already
+            # the FAST shape (1M keys / CAP 2^21, load 0.48) serves
+            # 100% at the serving default window
             fast_env["GUBER_PROBES"] = "8"
         out = attempt(fast_env, 1800)
         if out is not None:

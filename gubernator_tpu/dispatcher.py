@@ -18,6 +18,7 @@ analog of the batch coalescing the raw benchmark does by hand.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -71,6 +72,11 @@ class Dispatcher:
     #: Hard cap on how long a caller waits for its wave; protects the
     #: request handler from a wedged device (first compile is warmed by
     #: the daemon before serving, so steady-state waves are ms-scale).
+    #: GUBER_RESULT_TIMEOUT_S overrides: a cold wave compile through
+    #: the axon tunnel is 250-305 s, so any caller that can arrive
+    #: before warmup (benches, probes) must budget past the compile —
+    #: 120 s silently truncated the round-5 on-chip service sections
+    #: to an empty TimeoutError.
     RESULT_TIMEOUT_S = 120.0
 
     def __init__(self, engine, max_wave: int = 8192,
@@ -94,6 +100,15 @@ class Dispatcher:
         #: fast path to a pipeline that can't exist)
         self._pipelined = (self._want_pipeline()
                            and hasattr(engine, "launch_packed"))
+        env_timeout = os.environ.get("GUBER_RESULT_TIMEOUT_S", "")
+        if env_timeout:
+            try:
+                parsed = float(env_timeout)
+            except ValueError:
+                parsed = 0.0  # malformed: keep the class default
+            if parsed > 0:  # also rejects 0/negative/NaN — a 0 s wait
+                # would fail EVERY queued wave instantly
+                self.RESULT_TIMEOUT_S = parsed
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="device-dispatcher")
         self._thread.start()

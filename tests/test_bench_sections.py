@@ -74,3 +74,30 @@ def test_section_registry_covers_baseline_rows():
         assert row in declared, row
     for name in bench._SECTION_ORDER:
         assert name in bench._SECTIONS
+
+
+def test_flagship_defaults_are_the_round5_shape():
+    """The driver runs `python bench.py` with NO env: the defaults ARE
+    the flagship claim.  Round 5 moved it to CAP 2^26 / 8-probe (the
+    16-probe window triggers the serialized scatter lowering at CAP >=
+    2^25 on 2026-08 backend builds, while 2^26/8-probe is zero-loss for
+    the 10M-key populate — BASELINE.md round-5 table).  Import in a
+    child: bench's module-level env defaults must not leak here."""
+    code = (
+        "import os, json\n"
+        "import bench\n"
+        "print(json.dumps({'cap': bench.CAP, 'n_keys': bench.N_KEYS,\n"
+        "    'probes_env': os.environ.get('GUBER_PROBES', '')}))\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("GUBER_")}
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       timeout=120, stdout=subprocess.PIPE,
+                       stderr=subprocess.PIPE)
+    assert r.returncode == 0, r.stderr.decode()[-500:]
+    got = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert got["cap"] == 1 << 26, got
+    assert got["n_keys"] == 10_000_000, got
+    # bench must NOT export a probe override anymore: the serving
+    # default (core/step.py PROBES == 8) is the flagship window
+    assert got["probes_env"] == "", got

@@ -363,3 +363,26 @@ def test_mixed_wave_cross_now_merges_list_and_packed_jobs():
     for i, w in enumerate(want[3]):
         assert (int(st[i]), int(rem[i])) == (int(w.status), w.remaining)
     disp.close()
+
+
+def test_result_timeout_env_override(engine, monkeypatch):
+    """GUBER_RESULT_TIMEOUT_S must override the per-instance wait cap
+    (cold on-chip wave compiles are 250-305 s; the 120 s default
+    silently killed the round-5 live-window service sections), and a
+    malformed value must fall back to the class default."""
+    monkeypatch.setenv("GUBER_RESULT_TIMEOUT_S", "900")
+    d = Dispatcher(engine)
+    try:
+        assert d.RESULT_TIMEOUT_S == 900.0
+        assert Dispatcher.RESULT_TIMEOUT_S == 120.0  # class untouched
+    finally:
+        d.close()
+    for bad in ("not-a-number", "0", "-5", "nan"):
+        monkeypatch.setenv("GUBER_RESULT_TIMEOUT_S", bad)
+        d = Dispatcher(engine)
+        try:
+            # malformed/zero/negative/NaN all keep the default — a 0 s
+            # wait would fail every queued wave instantly
+            assert d.RESULT_TIMEOUT_S == 120.0, bad
+        finally:
+            d.close()

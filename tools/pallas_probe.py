@@ -1,0 +1,139 @@
+"""Diagnose the on-chip Pallas/Mosaic compile failure (round 5 live window).
+
+The battery's tier-3 probe died server-side (`HTTP 500:
+tpu_compile_helper subprocess exit code 1`) with the Mosaic diagnostic
+truncated by the checkpoint writer.  This probe answers, in order, with
+FULL untruncated error text written to /tmp/pallas_probe.json:
+
+  1. toy          — a trivial Pallas add kernel: can axon compile ANY
+                    Mosaic program at all?  (If this 500s, tier 3 is
+                    environmentally blocked, not a kernel bug.)
+  2. kernel_small — the real decision kernel at a TINY shape
+                    (CAP 2^12 table): does the failure depend on our
+                    kernel, independent of size?
+  3. kernel_big   — the real kernel at the battery's failing shape
+                    (CAP 2^22 → 2^23-row bucket table) IF 1+2 passed:
+                    is it a size/scratch limit?
+
+Single-client rule: run ONLY when no other jax process holds the relay.
+
+    timeout 1800 python tools/pallas_probe.py
+"""
+import json
+import os
+import sys
+import time
+import traceback
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.abspath(os.path.join(_HERE, ".."))
+sys.path.insert(0, _REPO)
+import _jax_cache
+
+_jax_cache.setup()
+
+OUT = "/tmp/pallas_probe.json"
+res: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
+
+
+def save():
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(res, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def attempt(name, fn):
+    t = time.time()
+    try:
+        out = fn()
+        res[name] = {"ok": True, "seconds": round(time.time() - t, 1),
+                     "out": out}
+    except Exception as e:  # noqa: BLE001 — full diagnostic capture is the point
+        res[name] = {"ok": False, "seconds": round(time.time() - t, 1),
+                     "error_type": type(e).__name__,
+                     "error": str(e),
+                     "traceback": traceback.format_exc()[-4000:]}
+    save()
+    print(f"[pallas_probe] {name}: ok={res[name]['ok']} "
+          f"({res[name]['seconds']}s)")
+    return res[name]["ok"]
+
+
+def toy():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def k(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] + y_ref[...]
+
+    x = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(x, x)
+    return {"sum": int(out.sum()), "backend": jax.default_backend()}
+
+
+def _kernel_at(log2cap, B=4096, reps=16):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _keyhash as keyhash
+    from gubernator_tpu.core.batch import RequestBatch
+    from gubernator_tpu.ops.pallas_step import (
+        decide_batch_pallas, init_pallas_table)
+
+    i64 = jnp.int64
+    rng = np.random.default_rng(5)
+    cap = 1 << log2cap
+    n_keys = max(cap // 2, 1)
+    pt = init_pallas_table(cap * 2)  # bucket layout, load /2 (as cap_ab)
+    keys = keyhash((rng.zipf(1.1, size=B) % n_keys).astype(np.uint64))
+    n = keys.shape[0]
+    batch = RequestBatch(
+        key=jnp.asarray(keys), hits=jnp.ones(n, i64),
+        limit=jnp.full(n, 100, i64), duration=jnp.full(n, 10_000, i64),
+        eff_ms=jnp.full(n, 10_000, i64), greg_end=jnp.zeros(n, i64),
+        behavior=jnp.zeros(n, jnp.int32),
+        algorithm=jnp.asarray(rng.integers(0, 2, size=n)
+                              .astype(np.int32)),
+        burst=jnp.full(n, 100, i64), valid=jnp.ones(n, bool))
+    now0 = jnp.asarray(1_760_000_000_000, i64)
+    t = time.time()
+    pt, out = decide_batch_pallas(pt, batch, now0)
+    jax.block_until_ready(out.status)
+    compile_s = round(time.time() - t, 1)
+    t = time.time()
+    for _ in range(reps):
+        pt, out = decide_batch_pallas(pt, batch, now0)
+    jax.block_until_ready(out.status)
+    dt = time.time() - t
+    err = float(np.asarray(out.err).mean())
+    return {"compile_s": compile_s,
+            "ms_per_step": round(dt / reps * 1e3, 3),
+            "decisions_per_s": round(reps * B / dt),
+            "err_fraction": round(err, 4),
+            "backend": jax.default_backend()}
+
+
+def main():
+    from gubernator_tpu.cmd import maybe_pin_platform
+
+    maybe_pin_platform()
+    import jax
+
+    res["backend_probe"] = jax.default_backend()
+    save()
+    ok_toy = attempt("toy", toy)
+    ok_small = attempt("kernel_small", lambda: _kernel_at(12))
+    if ok_toy and ok_small:
+        attempt("kernel_big", lambda: _kernel_at(22))
+    res["finished"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    save()
+    print(json.dumps(res, indent=1)[:2000])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

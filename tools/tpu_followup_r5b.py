@@ -1,0 +1,185 @@
+"""Round-5b follow-up battery: ONLY the questions the first live window
+left open (the full tpu_session battery already banked its record in
+artifacts/tpu_session_r5_attempt1.json — re-running it would spend the
+next window re-measuring answered questions).
+
+Stage order, each in its own subprocess (single-client tunnel):
+
+  1. probe        — trivial op (is the tunnel really back?)
+  2. capab_p8_25  — GUBER_PROBES=8 at CAP 2^25: the probe-window
+                    hypothesis.  16-probe shapes collapse at CAP >=
+                    2^25 (bench headline 0.35M dec/s) while 8-probe
+                    shapes fly clear up to 2^27 (cfg5 564M); K-split
+                    is ruled out at 2^25 (populate could not finish in
+                    21 min).  This is the missing single-variable A/B.
+  3. pallas_probe — toy Mosaic kernel vs the real kernel (tiny, then
+                    big): is the server-side `tpu_compile_helper exit 1`
+                    environmental or kernel-specific?
+  4. bench        — IF stage 2 verdicts FIXED: the driver-shaped bench
+                    at the 8-probe flagship (GUBER_PROBES=8 override,
+                    zero-loss audited by extra.populate_errs) — the
+                    north-star headline row.
+
+Results checkpoint to /tmp/tpu_followup_r5b.json and mirror into
+artifacts/tpu_followup_r5b.json after every stage.
+
+    timeout 10800 python tools/tpu_followup_r5b.py
+"""
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.abspath(os.path.join(_HERE, ".."))
+
+OUT = "/tmp/tpu_followup_r5b.json"
+MIRROR = os.path.join(_REPO, "artifacts", "tpu_followup_r5b.json")
+results: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
+_child = None
+
+
+def record(key, val):
+    results[key] = val
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1)
+    os.replace(tmp, OUT)
+    try:
+        shutil.copyfile(OUT, MIRROR)
+    except OSError:
+        pass
+    print(f"[r5b] {key}: {json.dumps(val)[:300]}", flush=True)
+
+
+def relay_alive(port=8103) -> bool:
+    s = socket.socket()
+    s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def _sigterm(_sig, _frm):
+    if _child is not None and _child.poll() is None:
+        try:
+            os.killpg(_child.pid, signal.SIGKILL)
+        except OSError:
+            pass
+    sys.exit(143)
+
+
+def run_stage(key, argv, timeout, env_extra=None):
+    """One stage, own process group; returns (ok, stdout_tail)."""
+    global _child
+    env = dict(os.environ, **(env_extra or {}))
+    t0 = time.time()
+    try:
+        _child = subprocess.Popen(argv, env=env, cwd=_REPO,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT,
+                                  start_new_session=True)
+        out, _ = _child.communicate(timeout=timeout)
+        rc = _child.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(_child.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, _ = _child.communicate()
+        rc = -9
+    finally:
+        _child = None
+    text = (out or b"").decode(errors="replace")
+    record(key + "__stage", {"rc": rc,
+                             "seconds": round(time.time() - t0, 1)})
+    # keep enough tail for bench.py's single-line final JSON (~2.6 KB
+    # in the round-5 record and growing) — a 2 KB cut truncated the
+    # line mid-object and the '{'-prefix scan found nothing
+    return rc == 0, text[-65536:]
+
+
+def merge(key, path, t_after):
+    try:
+        if os.path.getmtime(path) < t_after:
+            record(key, {"error": "stale checkpoint"})
+            return
+        with open(path) as f:
+            record(key, json.load(f))
+    except (OSError, ValueError) as e:
+        record(key, {"error": f"no checkpoint: {e}"})
+
+
+def main() -> int:
+    signal.signal(signal.SIGTERM, _sigterm)
+    if not relay_alive():
+        record("abort", "relay dead at start")
+        return 1
+
+    ok, out = run_stage("probe", [
+        sys.executable, "-c",
+        "import jax, json; print(json.dumps({'backend': "
+        "jax.default_backend(), 'sum': int(jax.numpy.arange(8).sum())}))"],
+        timeout=150)
+    if not ok or '"tpu"' not in out:
+        record("abort", f"probe failed: {out[-200:]}")
+        return 1
+
+    # 2. the probe-window A/B at the flagship CAP
+    t = time.time()
+    run_stage("capab_p8_25",
+              [sys.executable, os.path.join(_HERE, "cap_ab.py"), "25"],
+              timeout=1500, env_extra={"GUBER_PROBES": "8"})
+    merge("capab_p8_25", "/tmp/cap_ab.json", t)
+    if not relay_alive():
+        record("abort", "relay died during capab_p8_25")
+        return 1
+
+    # 3. the Mosaic compile diagnosis
+    t = time.time()
+    run_stage("pallas_probe",
+              [sys.executable, os.path.join(_HERE, "pallas_probe.py")],
+              timeout=1800)
+    merge("pallas_probe", "/tmp/pallas_probe.json", t)
+    if not relay_alive():
+        record("abort", "relay died during pallas_probe")
+        return 1
+
+    # 4. the headline: only if the 8-probe shape verifiably fixed the
+    # pathology (re-measuring a known-0.35M shape wastes the window)
+    verdict = (results.get("capab_p8_25") or {}).get("verdict", "")
+    if verdict in ("FIXED", "improved"):
+        partial = "/tmp/guber_bench_partial_r5b.json"
+        t = time.time()
+        # bench.py's round-5 defaults ARE the fixed flagship shape
+        # (CAP 2^26, 8-probe, offline-audited zero-loss) — no overrides
+        ok, out = run_stage(
+            "bench", [sys.executable, os.path.join(_REPO, "bench.py")],
+            timeout=7800,
+            env_extra={"GUBER_BENCH_PARTIAL": partial})
+        lines = [ln for ln in out.strip().splitlines()
+                 if ln.startswith("{")]
+        if ok and lines:
+            try:
+                record("bench", json.loads(lines[-1]))
+            except ValueError:
+                merge("bench_partial", partial, t)
+        else:
+            merge("bench_partial", partial, t)
+    else:
+        record("bench", {"skipped": f"capab_p8_25 verdict was "
+                                    f"{verdict!r}, not FIXED/improved"})
+    record("finished", time.strftime("%Y-%m-%d %H:%M:%S"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

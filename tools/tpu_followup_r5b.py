@@ -177,6 +177,24 @@ def main() -> int:
     else:
         record("bench", {"skipped": f"capab_p8_25 verdict was "
                                     f"{verdict!r}, not FIXED/improved"})
+
+    # 5. opportunistic: the device-batch sweep (VERDICT r1 item 1 —
+    # no on-chip point beyond B=65536 exists).  Two cold compiles; only
+    # attempted while the relay is still healthy after the bench.
+    if relay_alive() and "bench" in results \
+            and "skipped" not in results["bench"]:
+        ok, out = run_stage("b_sweep",
+                            [sys.executable,
+                             os.path.join(_HERE, "b_sweep.py"), "131072"],
+                            timeout=2400)
+        lines = [ln for ln in out.strip().splitlines()
+                 if ln.startswith(("[", "{"))]
+        if lines:
+            try:
+                record("b_sweep", json.loads(lines[-1]))
+            except ValueError:
+                record("b_sweep", {"error": "unparseable",
+                                   "raw": lines[-1][:500]})
     record("finished", time.strftime("%Y-%m-%d %H:%M:%S"))
     return 0
 

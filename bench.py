@@ -579,6 +579,18 @@ def _make_reqs(rng, name="svc"):
             for _ in range(4)]
 
 
+def _telemetry_rows(inst) -> dict:
+    """Dispatcher wave-telemetry snapshot for a section's BENCH row
+    (wave-size/step-duration percentiles, stall/timeout counts — see
+    OBSERVABILITY.md).  A future perf round that loses a section to a
+    slow wave diagnoses itself from this block instead of an empty
+    TimeoutError (the round-5 failure shape)."""
+    try:
+        return inst.dispatcher.telemetry_snapshot()
+    except Exception as e:  # noqa: BLE001 - telemetry must not cost rows
+        return {"error": (str(e) or repr(e))[:200]}
+
+
 def _serialize_reqs(reqs_lists):
     """[[RateLimitRequest]] → serialized GetRateLimitsReq bytes."""
     from gubernator_tpu.proto import gubernator_pb2 as pb
@@ -857,6 +869,8 @@ def _sec_svc():
                 "batch": 1000}
         except Exception as e:  # noqa: BLE001
             out["8_peer_path"] = {"error": (str(e) or repr(e))[:200]}
+        if "6_service_path" in out:
+            out["6_service_path"]["telemetry"] = _telemetry_rows(inst)
     finally:
         inst.close()
     return out
@@ -889,7 +903,8 @@ def _sec_cluster():
         lane = inst0.metrics.wire_lane_counter.labels(
             lane="wire_clustered")._value.get()
         row = {"decisions_per_s": round(dps_c3), "daemons": 3,
-               "wire_clustered_requests": int(lane)}
+               "wire_clustered_requests": int(lane),
+               "telemetry": _telemetry_rows(inst0)}
         cores = _host_cores()
         if cores < 3:
             # VERDICT r2 weak #3: without this, the row reads as a
@@ -1257,6 +1272,7 @@ def _sec_pallas():
         row["svc_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
         row["svc_p99_ms"] = round(float(np.percentile(lat, 99)), 3)
         row["occupancy"] = int(inst.engine.occupancy())
+        row["telemetry"] = _telemetry_rows(inst)
     finally:
         inst.close()
     if cpu:

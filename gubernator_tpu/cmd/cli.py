@@ -1,9 +1,13 @@
-"""Load-test CLI: fire GetRateLimits traffic, report latency/throughput.
+"""Load-test + debug CLI.
 
 reference: cmd/gubernator-cli/main.go — reconstructed, mount empty.
 Usage: python -m gubernator_tpu.cmd.cli --address host:port
        [--rate-limits N] [--concurrency C] [--batch B] [--duration S]
        [--zipf A] [--http]
+
+Debug subcommand (the flight-recorder round trip, OBSERVABILITY.md):
+       python -m gubernator_tpu.cmd.cli debug events
+       [--url http://host:port] [--limit N] [--json] [--kind K]
 """
 from __future__ import annotations
 
@@ -16,7 +20,66 @@ import time
 import numpy as np
 
 
+def _debug_main(argv) -> int:
+    """``debug events``: fetch the daemon's flight-recorder ring from
+    GET /debug/events and print it (one line per event, or raw JSON)."""
+    import urllib.request
+
+    ap = argparse.ArgumentParser(
+        prog="guber-cli debug",
+        description="gubernator-tpu debug introspection")
+    sub = ap.add_subparsers(dest="what", required=True)
+    ev = sub.add_parser("events",
+                        help="dump the daemon's flight-recorder ring")
+    ev.add_argument("--url", default="http://localhost:1050",
+                    help="daemon HTTP base url (or a full "
+                         "/debug/events url)")
+    ev.add_argument("--limit", type=int, default=0,
+                    help="only the newest N events")
+    ev.add_argument("--kind", default="",
+                    help="only events of this kind (e.g. wave_stalled)")
+    ev.add_argument("--timeout", type=float, default=10.0)
+    ev.add_argument("--json", action="store_true",
+                    help="print the raw JSON document")
+    args = ap.parse_args(argv)
+
+    url = args.url
+    if "/debug/events" not in url:
+        url = url.rstrip("/") + "/debug/events"
+    if args.limit > 0:
+        url += ("&" if "?" in url else "?") + f"limit={args.limit}"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as f:
+            body = json.loads(f.read())
+    except Exception as e:  # noqa: BLE001
+        print(f"fetch failed: {e!r}", file=sys.stderr)
+        return 1
+    events = body.get("events", [])
+    if args.kind:
+        events = [e for e in events if e.get("kind") == args.kind]
+    if args.json:
+        print(json.dumps({"events": events}))
+        return 0
+    for e in events:
+        seq, kind = e.get("seq"), e.get("kind")
+        t_ms, trace = e.get("t_ms"), e.get("trace")
+        rest = {k: v for k, v in e.items()
+                if k not in ("seq", "kind", "t_ms", "trace")}
+        line = f"#{seq} t={t_ms} {kind}"
+        if trace:
+            line += f" trace={trace}"
+        if rest:
+            line += " " + json.dumps(rest, sort_keys=True)
+        print(line)
+    if not events:
+        print("(no events)", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "debug":
+        return _debug_main(argv[1:])
     ap = argparse.ArgumentParser(description="gubernator-tpu load tester")
     ap.add_argument("--address", default="localhost:1051")
     ap.add_argument("--http", action="store_true",
@@ -66,7 +129,7 @@ def main(argv=None) -> int:
                 resps = c.get_rate_limits(reqs)
             except Exception as e:  # noqa: BLE001
                 with lock:
-                    errs.append(str(e))
+                    errs.append(str(e) or repr(e))
                 return
             dt = time.perf_counter() - t0
             counts[w] += len(resps)

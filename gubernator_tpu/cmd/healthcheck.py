@@ -1,7 +1,14 @@
 """Container healthcheck: exit 0 iff the daemon reports healthy.
 
 reference: cmd/healthcheck/main.go — reconstructed, mount empty.
-Usage: python -m gubernator_tpu.cmd.healthcheck [--url URL]
+Usage: python -m gubernator_tpu.cmd.healthcheck [--url URL] [--deep]
+
+``--deep`` requests the daemon's deep health mode (``/healthz?deep=1``)
+and prints the dispatcher block (queue depth, last-wave age, stalled
+state — see OBSERVABILITY.md).  A diagnosed stall does NOT flip the
+exit code by itself (a cold compile recovers on its own; restarting the
+container mid-compile would make it worse) unless ``--fail-on-stall``
+is also given.
 """
 from __future__ import annotations
 
@@ -9,22 +16,50 @@ import argparse
 import json
 import sys
 import urllib.request
+from urllib.parse import urlencode, urlsplit, urlunsplit
+
+
+def _with_deep(url: str) -> str:
+    """Append deep=1 to the url's query string (preserving any query)."""
+    parts = urlsplit(url)
+    q = parts.query + ("&" if parts.query else "") + urlencode({"deep": 1})
+    return urlunsplit((parts.scheme, parts.netloc, parts.path, q,
+                       parts.fragment))
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", default="http://localhost:1050/v1/HealthCheck")
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--deep", action="store_true",
+                    help="request dispatcher queue/wave/stall state "
+                         "(/healthz?deep=1) and print it")
+    ap.add_argument("--fail-on-stall", action="store_true",
+                    help="with --deep: exit 1 when the dispatcher "
+                         "reports a stalled wave")
     args = ap.parse_args(argv)
+    url = _with_deep(args.url) if args.deep else args.url
     try:
-        with urllib.request.urlopen(args.url, timeout=args.timeout) as f:
+        with urllib.request.urlopen(url, timeout=args.timeout) as f:
             body = json.loads(f.read())
     except Exception as e:  # noqa: BLE001
-        print(f"unhealthy: {e}", file=sys.stderr)
+        # str() of a socket timeout can be empty — keep the repr
+        print(f"unhealthy: {e!r}", file=sys.stderr)
         return 1
     if body.get("status") != "healthy":
         print(f"unhealthy: {body}", file=sys.stderr)
         return 1
+    disp = body.get("dispatcher")
+    if args.deep and disp is not None:
+        print("dispatcher:", json.dumps(disp, sort_keys=True))
+        if disp.get("stalled"):
+            print("WARNING: dispatcher reports a stalled wave "
+                  f"(oldest_wave_age_s={disp.get('oldest_wave_age_s')}, "
+                  f"threshold={disp.get('stall_threshold_s')}s) — "
+                  "likely a cold device compile in flight",
+                  file=sys.stderr)
+            if args.fail_on_stall:
+                return 1
     print("healthy")
     return 0
 

@@ -6,7 +6,10 @@ empty.  Serves:
 - gRPC V1 + PeersV1 on ``grpc_listen_address`` (TLS optional),
 - an HTTP/JSON gateway on ``http_listen_address`` mirroring the
   reference's grpc-gateway mux: POST /v1/GetRateLimits,
-  GET /v1/HealthCheck, plus GET /metrics (prometheus) and GET /healthz,
+  GET /v1/HealthCheck, plus GET /metrics (prometheus), GET /healthz
+  (``?deep=1`` adds dispatcher queue/wave/stall state), and
+  GET /debug/events (the flight-recorder ring as JSON — see
+  OBSERVABILITY.md),
 - the configured discovery source wired to instance.set_peers.
 """
 from __future__ import annotations
@@ -29,6 +32,7 @@ from .netutil import resolve_host_ip, split_host_port
 from .proto import gubernator_pb2 as pb
 from .proto import peers_pb2 as peers_pb
 from .store import FileLoader
+from .telemetry import exc_text
 from .tlsutil import setup_tls
 from .tracing import grpc_request_context, request_context, span
 from .types import Behavior, PeerInfo, RateLimitRequest
@@ -48,7 +52,7 @@ class _V1Servicer:
                 reqs = [req_from_pb(m) for m in request.requests]
                 resps = self.instance.get_rate_limits(reqs)
             except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, exc_text(e))
             out = pb.GetRateLimitsResp()
             out.responses.extend(resp_to_pb(r) for r in resps)
             return out
@@ -62,7 +66,7 @@ class _V1Servicer:
             try:
                 return self.instance.get_rate_limits_wire(request)
             except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, exc_text(e))
 
     def HealthCheck(self, request: pb.HealthCheckReq, context):
         return health_to_pb(self.instance.health_check())
@@ -81,7 +85,7 @@ class _PeersServicer:
                 reqs = [req_from_pb(m) for m in request.requests]
                 resps = self.instance.get_peer_rate_limits(reqs)
             except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, exc_text(e))
             out = peers_pb.GetPeerRateLimitsResp()
             out.rate_limits.extend(resp_to_pb(r) for r in resps)
             return out
@@ -94,7 +98,7 @@ class _PeersServicer:
             try:
                 return self.instance.get_peer_rate_limits_wire(request)
             except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, exc_text(e))
 
     def UpdatePeerGlobals(self, request: peers_pb.UpdatePeerGlobalsReq,
                           context):
@@ -267,15 +271,36 @@ class Daemon:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/metrics":
+                from urllib.parse import parse_qs, urlsplit
+
+                parts = urlsplit(self.path)
+                path, q = parts.path, parse_qs(parts.query)
+                if path == "/metrics":
                     self._send(200, daemon.instance.metrics.render(),
                                "text/plain; version=0.0.4")
-                elif self.path in ("/v1/HealthCheck", "/healthz"):
+                elif path in ("/v1/HealthCheck", "/healthz"):
                     h = daemon.instance.health_check()
                     code = 200 if h.status == "healthy" else 503
-                    self._send(code, json.dumps({
-                        "status": h.status, "message": h.message,
-                        "peer_count": h.peer_count}).encode())
+                    body = {"status": h.status, "message": h.message,
+                            "peer_count": h.peer_count}
+                    if q.get("deep", ["0"])[-1] not in ("", "0", "false"):
+                        # deep mode: dispatcher queue depth, last-wave
+                        # age, stalled state — the stall watchdog's
+                        # view, for probes that want a diagnosis and
+                        # not just liveness (cmd/healthcheck.py --deep)
+                        body["dispatcher"] = \
+                            daemon.instance.dispatcher.debug_stats()
+                    self._send(code, json.dumps(body).encode())
+                elif path == "/debug/events":
+                    # flight recorder ring (telemetry.py), newest-last;
+                    # ?limit=N keeps only the newest N events
+                    try:
+                        limit = int(q.get("limit", ["0"])[-1]) or None
+                    except ValueError:
+                        limit = None
+                    self._send(200, json.dumps({
+                        "events": daemon.instance.recorder.events(
+                            limit=limit)}).encode())
                 else:
                     self._send(404, b'{"error":"not found"}')
 
@@ -292,7 +317,8 @@ class Daemon:
                     with request_context(self.headers.get("traceparent")):
                         resps = daemon.instance.get_rate_limits(reqs)
                 except ValueError as e:
-                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    self._send(400, json.dumps(
+                        {"error": exc_text(e)}).encode())
                     return
                 self._send(200, json.dumps({
                     "responses": [_resp_to_json(r) for r in resps]}).encode())

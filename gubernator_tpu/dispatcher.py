@@ -23,6 +23,7 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import List, Optional, Sequence, Tuple
 
 from .types import RateLimitRequest, RateLimitResponse
@@ -45,25 +46,30 @@ def _concat_columns(parts):
 
 
 class _Job:
-    __slots__ = ("reqs", "now_ms", "future")
+    __slots__ = ("reqs", "now_ms", "future", "t_enq", "trace")
 
     def __init__(self, reqs, now_ms):
         self.reqs = reqs
         self.now_ms = now_ms
         self.future: Future = Future()
+        #: stamped by _submit: queue-wait start + caller's trace id
+        self.t_enq: Optional[float] = None
+        self.trace: Optional[str] = None
 
 
 class _PackedJob:
     """Columnar job (C++ wire-ingest lane): a RequestBatch of numpy
     columns + key hashes instead of RateLimitRequest objects."""
 
-    __slots__ = ("batch", "khash", "now_ms", "future")
+    __slots__ = ("batch", "khash", "now_ms", "future", "t_enq", "trace")
 
     def __init__(self, batch, khash, now_ms):
         self.batch = batch
         self.khash = khash
         self.now_ms = now_ms
         self.future: Future = Future()
+        self.t_enq: Optional[float] = None
+        self.trace: Optional[str] = None
 
 
 class Dispatcher:
@@ -79,12 +85,42 @@ class Dispatcher:
     #: to an empty TimeoutError.
     RESULT_TIMEOUT_S = 120.0
 
+    #: Default stall threshold: a wave in flight this long is flagged by
+    #: the watchdog (gauge + log + recorder event) — deliberately well
+    #: below RESULT_TIMEOUT_S so a cold compile surfaces as a DIAGNOSED
+    #: stall minutes before callers give up.  GUBER_STALL_THRESHOLD_S
+    #: overrides; <= 0 disables the watchdog.
+    STALL_THRESHOLD_S = 30.0
+
     def __init__(self, engine, max_wave: int = 8192,
                  max_delay_ms: float = 0.2,
-                 lock: Optional[threading.Lock] = None):
+                 lock: Optional[threading.Lock] = None,
+                 metrics=None, recorder=None, clock=time.monotonic):
         self.engine = engine
         self.max_wave = max_wave
         self.max_delay_s = max_delay_ms / 1000.0
+        #: per-instance Metrics registry (metrics.py) and FlightRecorder
+        #: (telemetry.py); both optional — a bare Dispatcher (tests,
+        #: library use) pays only the cheap internal counters.
+        self.metrics = metrics
+        self.recorder = recorder
+        self._clock = clock
+        # --- wave telemetry state (all under _tel_mu) ---
+        self._tel_mu = threading.Lock()
+        self._inflight: dict = {}  # wave_id → {t0, kind, size, trace, stalled}
+        self._wave_seq = 0
+        self._wave_count = 0
+        self._stall_count = 0
+        self._timeout_count = 0
+        self._first_wave_s: Optional[float] = None
+        self._last_wave_end: Optional[float] = None
+        from collections import deque as _deque
+
+        #: bounded recent-wave samples for telemetry_snapshot percentiles
+        #: (prometheus histograms can't answer percentile queries)
+        self._recent_sizes: "_deque" = _deque(maxlen=4096)
+        self._recent_durs: "_deque" = _deque(maxlen=4096)
+        self._recent_waits: "_deque" = _deque(maxlen=4096)
         #: Shared with the instance's row-level ops (gather/upsert/
         #: restore/sweep), which run on other threads and mutate the
         #: same engine state.
@@ -109,6 +145,32 @@ class Dispatcher:
             if parsed > 0:  # also rejects 0/negative/NaN — a 0 s wait
                 # would fail EVERY queued wave instantly
                 self.RESULT_TIMEOUT_S = parsed
+        # Stall watchdog: default well below the result timeout (and
+        # scaled down with it, so a tightened timeout keeps the "stall
+        # first, timeout later" ordering).  An explicit env value is an
+        # operator choice and is honored verbatim; <= 0 disables.
+        stall_env = os.environ.get("GUBER_STALL_THRESHOLD_S", "")
+        if stall_env:
+            try:
+                self._stall_threshold_s = float(stall_env)
+            except ValueError:
+                self._stall_threshold_s = min(
+                    self.STALL_THRESHOLD_S, self.RESULT_TIMEOUT_S / 4.0)
+            if self._stall_threshold_s != self._stall_threshold_s:  # NaN
+                self._stall_threshold_s = 0.0
+        else:
+            self._stall_threshold_s = min(
+                self.STALL_THRESHOLD_S, self.RESULT_TIMEOUT_S / 4.0)
+        self._watchdog: Optional[threading.Thread] = None
+        if self._stall_threshold_s > 0:
+            #: poll well inside the threshold so a stall is flagged
+            #: promptly after it crosses the line
+            self._watch_interval_s = max(
+                min(self._stall_threshold_s / 4.0, 1.0), 0.02)
+            self._watchdog = threading.Thread(
+                target=self._watchdog_run, daemon=True,
+                name="dispatcher-watchdog")
+            self._watchdog.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="device-dispatcher")
         self._thread.start()
@@ -167,13 +229,23 @@ class Dispatcher:
         thread handoff)."""
         if self._try_inline():
             try:
-                with self._engine_lock:
-                    return self.engine.check_batch(list(reqs), now_ms)
+                wid = self._wave_begin("inline", nreq=len(reqs))
+                try:
+                    with self._engine_lock:
+                        out = self.engine.check_batch(list(reqs), now_ms)
+                except Exception as e:  # noqa: BLE001 - recorded, re-raised
+                    self._wave_end(wid, error=e)
+                    raise
+                self._wave_end(wid)
+                return out
             finally:
                 self._inline_mu.release()
         job = _Job(list(reqs), now_ms)
         self._submit(job)
-        return job.future.result(timeout=self.RESULT_TIMEOUT_S)
+        try:
+            return job.future.result(timeout=self.RESULT_TIMEOUT_S)
+        except FuturesTimeout as e:
+            raise self._result_timeout(e) from e
 
     def check_packed(self, batch, khash, now_ms: int) -> tuple:
         """Columnar submit (see engine.check_packed); coalesces with
@@ -181,21 +253,245 @@ class Dispatcher:
         (a lone packed job's wave is exactly engine.check_packed)."""
         if self._try_inline():
             try:
-                with self._engine_lock:
-                    return self.engine.check_packed(batch, khash, now_ms)
+                wid = self._wave_begin("inline_packed", nreq=len(khash))
+                try:
+                    with self._engine_lock:
+                        out = self.engine.check_packed(batch, khash,
+                                                       now_ms)
+                except Exception as e:  # noqa: BLE001 - recorded, re-raised
+                    self._wave_end(wid, error=e)
+                    raise
+                self._wave_end(wid)
+                return out
             finally:
                 self._inline_mu.release()
         job = _PackedJob(batch, khash, now_ms)
         self._submit(job)
-        return job.future.result(timeout=self.RESULT_TIMEOUT_S)
+        try:
+            return job.future.result(timeout=self.RESULT_TIMEOUT_S)
+        except FuturesTimeout as e:
+            raise self._result_timeout(e) from e
 
     def _submit(self, job) -> None:
+        from .tracing import current_trace_id
+
+        job.t_enq = self._clock()
+        job.trace = current_trace_id()
         with self._submit_mu:
             # checked under the same lock close() takes, so a job can
             # never slip into the queue after the final drain
             if self._closing.is_set():
                 raise RuntimeError("dispatcher is closed")
             self._queue.put(job)
+
+    # ---- wave telemetry -------------------------------------------------
+    #
+    # Every engine execution — inline, list, packed, merged, pipelined
+    # launch/sync — is ONE wave: _wave_begin observes size + per-job
+    # queue waits and registers the wave in _inflight (the watchdog's
+    # scan set); _wave_end observes duration and resolves stall state.
+    # All metric/recorder emission is None-guarded: a bare Dispatcher
+    # costs two dict ops and a few deque appends per wave.
+
+    def _wave_begin(self, kind: str, jobs=None, nreq: int = 0,
+                    trace: Optional[str] = None) -> int:
+        t0 = self._clock()
+        waits = []
+        if jobs:
+            nreq = sum(_job_len(j) for j in jobs)
+            for j in jobs:
+                if j.t_enq is not None:
+                    waits.append(max(t0 - j.t_enq, 0.0))
+                if trace is None:
+                    trace = j.trace
+        elif trace is None:
+            # inline wave: the caller thread IS the request handler, so
+            # its trace context is live right here
+            from .tracing import current_trace_id
+
+            trace = current_trace_id()
+        with self._tel_mu:
+            self._wave_seq += 1
+            wid = self._wave_seq
+            self._inflight[wid] = {"t0": t0, "kind": kind, "size": nreq,
+                                   "trace": trace, "stalled": False}
+            self._recent_sizes.append(nreq)
+            self._recent_waits.extend(waits)
+        if self.metrics is not None:
+            self.metrics.wave_size.observe(nreq)
+            for w in waits:
+                self.metrics.wave_queue_wait.observe(w)
+            self.metrics.waves_in_flight.inc()
+        if self.recorder is not None:
+            self.recorder.record("wave_launched", trace=trace, wave=wid,
+                                 wave_kind=kind, size=nreq,
+                                 jobs=len(jobs) if jobs else 1)
+        return wid
+
+    def _wave_end(self, wid: int, error: Optional[BaseException] = None
+                  ) -> None:
+        t1 = self._clock()
+        with self._tel_mu:
+            info = self._inflight.pop(wid, None)
+            if info is None:  # already ended (defensive)
+                return
+            dur = max(t1 - info["t0"], 0.0)
+            self._wave_count += 1
+            first = self._wave_count == 1
+            if first:
+                self._first_wave_s = dur
+            self._recent_durs.append(dur)
+            self._last_wave_end = t1
+            was_stalled = info["stalled"]
+            any_stalled = any(i["stalled"]
+                              for i in self._inflight.values())
+        if self.metrics is not None:
+            self.metrics.wave_duration.observe(dur)
+            self.metrics.waves_in_flight.dec()
+            if first:
+                self.metrics.first_wave_duration.set(dur)
+            if was_stalled and not any_stalled:
+                self.metrics.dispatcher_stalled.set(0)
+        if was_stalled:
+            log.warning("dispatcher stall resolved: wave %d (%s, %d "
+                        "reqs) completed after %.1fs%s", wid,
+                        info["kind"], info["size"], dur,
+                        " with error" if error is not None else "")
+        if self.recorder is not None:
+            from .telemetry import exc_text
+
+            ev = {"trace": info["trace"], "wave": wid,
+                  "wave_kind": info["kind"], "size": info["size"],
+                  "duration_ms": round(dur * 1000, 3)}
+            if error is not None:
+                self.recorder.record("wave_error", error=exc_text(error),
+                                     **ev)
+            else:
+                self.recorder.record("wave_completed", **ev)
+            if first:
+                # the compile event: the first wave pays any compile
+                # the warmup didn't cover (cold tunnel: 250-305 s)
+                self.recorder.record("first_wave", trace=info["trace"],
+                                     duration_ms=round(dur * 1000, 3))
+
+    def _watchdog_run(self) -> None:
+        while not self._closing.wait(self._watch_interval_s):
+            try:
+                self._watchdog_poll()
+            except Exception:  # pragma: no cover - must never die
+                log.exception("dispatcher watchdog poll")
+
+    def _watchdog_poll(self) -> bool:
+        """One watchdog scan: flag waves in flight past the threshold.
+        Separated from the thread loop so tests drive it with a fake
+        clock (no real sleeps).  Returns True when a NEW stall was
+        flagged this scan."""
+        now = self._clock()
+        newly = []
+        with self._tel_mu:
+            for wid, info in self._inflight.items():
+                if (not info["stalled"]
+                        and now - info["t0"] >= self._stall_threshold_s):
+                    info["stalled"] = True
+                    newly.append((wid, dict(info)))
+            self._stall_count += len(newly)
+            any_stalled = any(i["stalled"]
+                              for i in self._inflight.values())
+        if self.metrics is not None:
+            self.metrics.dispatcher_stalled.set(1 if any_stalled else 0)
+        for wid, info in newly:
+            age = now - info["t0"]
+            msg = (f"wave {wid} ({info['kind']}, {info['size']} reqs) in "
+                   f"flight {age:.1f}s > stall threshold "
+                   f"{self._stall_threshold_s:.1f}s — likely a cold "
+                   f"device compile; callers time out at "
+                   f"{self.RESULT_TIMEOUT_S:.0f}s "
+                   f"(GUBER_RESULT_TIMEOUT_S)")
+            log.warning("dispatcher stall: %s", msg)
+            if self.metrics is not None:
+                self.metrics.stall_event_counter.inc()
+            if self.recorder is not None:
+                self.recorder.record("wave_stalled", error=msg,
+                                     trace=info["trace"], wave=wid,
+                                     wave_kind=info["kind"],
+                                     size=info["size"],
+                                     age_s=round(age, 3))
+        return bool(newly)
+
+    def _result_timeout(self, e: BaseException) -> BaseException:
+        """Build the caller-facing timeout with a wave diagnosis baked
+        into the message — str() of a bare TimeoutError is EMPTY, which
+        made the round-5 rows undiagnosable.  Same exception type, so
+        existing handlers keep matching."""
+        stats = self.debug_stats()
+        msg = (f"dispatcher wave result timed out after "
+               f"{self.RESULT_TIMEOUT_S:.0f}s (queue_depth="
+               f"{stats['queue_depth']}, in_flight={stats['in_flight']}, "
+               f"oldest_wave_age_s={stats['oldest_wave_age_s']}, "
+               f"stalled={stats['stalled']}; a cold tunnel compile is "
+               f"250-305 s — raise GUBER_RESULT_TIMEOUT_S when callers "
+               f"can arrive before warmup)")
+        with self._tel_mu:
+            self._timeout_count += 1
+        if self.metrics is not None:
+            self.metrics.wave_timeout_counter.inc()
+        if self.recorder is not None:
+            self.recorder.record("wave_timeout", error=msg)
+        return type(e)(msg)
+
+    def debug_stats(self) -> dict:
+        """Cheap dispatcher state for /healthz?deep=1 and timeout
+        diagnoses — no device work."""
+        now = self._clock()
+        with self._tel_mu:
+            inflight = [dict(i) for i in self._inflight.values()]
+            last_end = self._last_wave_end
+            waves, stalls = self._wave_count, self._stall_count
+            timeouts, first = self._timeout_count, self._first_wave_s
+        oldest = max((now - i["t0"] for i in inflight), default=None)
+        return {
+            "queue_depth": self._queue.qsize(),
+            "in_flight": len(inflight),
+            "oldest_wave_age_s": (round(oldest, 3)
+                                  if oldest is not None else None),
+            "last_wave_age_s": (round(now - last_end, 3)
+                                if last_end is not None else None),
+            "stalled": any(i["stalled"] for i in inflight),
+            "waves": waves,
+            "stall_events": stalls,
+            "timeouts": timeouts,
+            "first_wave_s": (round(first, 3)
+                             if first is not None else None),
+            "stall_threshold_s": self._stall_threshold_s,
+            "result_timeout_s": self.RESULT_TIMEOUT_S,
+        }
+
+    def telemetry_snapshot(self) -> dict:
+        """debug_stats + recent-wave percentiles (bench.py folds this
+        into each section's BENCH JSON row so perf rounds are
+        self-diagnosing)."""
+        import numpy as np
+
+        with self._tel_mu:
+            sizes = list(self._recent_sizes)
+            durs = list(self._recent_durs)
+            waits = list(self._recent_waits)
+
+        def pct(xs, p, scale=1.0, nd=3):
+            if not xs:
+                return None
+            return round(float(np.percentile(xs, p)) * scale, nd)
+
+        snap = self.debug_stats()
+        snap.update({
+            "wave_size_p50": pct(sizes, 50),
+            "wave_size_p99": pct(sizes, 99),
+            "wave_duration_p50_ms": pct(durs, 50, 1e3),
+            "wave_duration_p99_ms": pct(durs, 99, 1e3),
+            "queue_wait_p50_ms": pct(waits, 50, 1e3),
+            "queue_wait_p99_ms": pct(waits, 99, 1e3),
+        })
+        return snap
 
     # ---- the merge loop -------------------------------------------------
 
@@ -295,9 +591,11 @@ class Dispatcher:
             self._sync_and_resolve(*pending.popleft())
 
     def _launch_packed_jobs(self, jobs):
-        """Concat + LAUNCH a pure-packed wave; returns (jobs, token) for
-        the sync phase, or None when dispatch failed (futures already
-        resolved with the error)."""
+        """Concat + LAUNCH a pure-packed wave; returns (jobs, token,
+        wave_id) for the sync phase, or None when dispatch failed
+        (futures already resolved with the error).  The wave stays "in
+        flight" (watchdog-visible) from launch until its sync resolves."""
+        wid = self._wave_begin("packed_pipelined", jobs)
         try:
             if len(jobs) == 1:
                 batch, khash = jobs[0].batch, jobs[0].khash
@@ -307,14 +605,15 @@ class Dispatcher:
             now = max(j.now_ms for j in jobs)
             with self._engine_lock:
                 token = self.engine.launch_packed(batch, khash, now)
-            return (jobs, token)
+            return (jobs, token, wid)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
+            self._wave_end(wid, error=e)
             for j in jobs:
                 if not j.future.done():
                     j.future.set_exception(e)
             return None
 
-    def _sync_and_resolve(self, jobs, token) -> None:
+    def _sync_and_resolve(self, jobs, token, wid) -> None:
         try:
             cols = self.engine.sync_packed(
                 token, engine_lock=self._engine_lock)
@@ -323,7 +622,9 @@ class Dispatcher:
                 b = a + len(j.khash)
                 j.future.set_result(tuple(c[a:b] for c in cols))
                 a = b
+            self._wave_end(wid)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
+            self._wave_end(wid, error=e)
             for j in jobs:
                 if not j.future.done():
                     j.future.set_exception(e)
@@ -339,6 +640,19 @@ class Dispatcher:
         from .hashing import hash_request_keys
         from .parallel.sharded import responses_from_columns
 
+        wid = self._wave_begin("merged", wave)
+        try:
+            self._run_merged_wave_inner(
+                wave, np, pack_requests, hash_request_keys,
+                responses_from_columns)
+        except Exception as e:  # noqa: BLE001 - caller fails the futures
+            self._wave_end(wid, error=e)
+            raise
+        self._wave_end(wid)
+
+    def _run_merged_wave_inner(self, wave, np, pack_requests,
+                               hash_request_keys,
+                               responses_from_columns) -> None:
         parts = []  # (job, batch, khash, errs or None)
         for j in wave:
             if isinstance(j, _PackedJob):
@@ -375,12 +689,15 @@ class Dispatcher:
             start = len(merged)
             merged.extend(j.reqs)
             slices.append((j, start, len(merged)))
+        wid = self._wave_begin("list", jobs)
         try:
             with self._engine_lock:
                 resps = self.engine.check_batch(merged, now)
             for j, a, b in slices:
                 j.future.set_result(resps[a:b])
+            self._wave_end(wid)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
+            self._wave_end(wid, error=e)
             for j, _, _ in slices:
                 if not j.future.done():
                     j.future.set_exception(e)
@@ -390,6 +707,7 @@ class Dispatcher:
             return
         import numpy as np
 
+        wid = self._wave_begin("packed", jobs)
         try:
             if len(jobs) == 1:
                 batch, khash = jobs[0].batch, jobs[0].khash
@@ -406,7 +724,9 @@ class Dispatcher:
                 b = a + len(j.khash)
                 j.future.set_result(tuple(c[a:b] for c in cols))
                 a = b
+            self._wave_end(wid)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
+            self._wave_end(wid, error=e)
             for j in jobs:
                 if not j.future.done():
                     j.future.set_exception(e)
@@ -422,6 +742,8 @@ class Dispatcher:
         with self._inline_mu:
             pass
         self._thread.join(timeout=10)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
         while True:
             try:
                 job = self._queue.get_nowait()

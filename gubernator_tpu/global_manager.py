@@ -22,6 +22,7 @@ from typing import Dict, List, Tuple
 
 from .config import BehaviorConfig
 from .interval import IntervalLoop
+from .telemetry import exc_text
 from .types import Behavior, RateLimitRequest
 
 log = logging.getLogger("gubernator_tpu.global")
@@ -180,10 +181,14 @@ class GlobalManager:
                         reqs[i:i + limit],
                         timeout_s=self.behaviors.global_timeout_ms / 1000.0)
             except Exception as e:  # noqa: BLE001 - next tick retries fresh
-                errors.append(f"global hits sync to {addr}: {e}")
+                # exc_text: a peer deadline/TimeoutError str()s empty
+                errors.append(f"global hits sync to {addr}: "
+                              f"{exc_text(e)}")
                 self.metrics.check_error_counter.labels(
                     error="global_hits_sync").inc()
                 log.warning(errors[-1])
+                self._record_event("error", stage="global_hits_sync",
+                                   error=errors[-1])
         self._record(errors)
 
     def _run_broadcasts(self) -> None:
@@ -218,13 +223,16 @@ class GlobalManager:
                     peer.update_peer_globals(msgs[i:i + limit])
             except Exception as e:  # noqa: BLE001
                 errors.append(f"global broadcast to "
-                              f"{peer.info.grpc_address}: {e}")
+                              f"{peer.info.grpc_address}: {exc_text(e)}")
                 self.metrics.check_error_counter.labels(
                     error="global_broadcast").inc()
                 log.warning(errors[-1])
         self._record(errors)
         self.metrics.global_broadcast_counter.inc()
         self.metrics.broadcast_duration.observe(time.perf_counter() - t0)
+        self._record_event("broadcast", keys=len(msgs), peers=len(peers),
+                           errors=len(errors),
+                           error=("; ".join(errors) or None))
 
     # ---- error surfacing (health_check) --------------------------------
 
@@ -232,6 +240,12 @@ class GlobalManager:
     #: daemon unhealthy (the loops retry every tick; a stale error would
     #: otherwise fail readiness probes forever).
     ERROR_TTL_S = 60.0
+
+    def _record_event(self, kind: str, **fields) -> None:
+        """Best-effort flight-recorder hook (instance owns the ring)."""
+        rec = getattr(self.instance, "recorder", None)
+        if rec is not None:
+            rec.record(kind, **fields)
 
     def _record(self, errors) -> None:
         """Per-tick error aggregation: success clears, failure stamps."""

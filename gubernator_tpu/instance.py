@@ -29,6 +29,7 @@ from .metrics import Metrics
 from .multiregion import MultiRegionManager
 from .peer_client import ErrClosing, PeerClient
 from .peers import RegionPeerPicker, ReplicatedConsistentHash
+from .telemetry import FlightRecorder, exc_text
 from .proto import gubernator_pb2 as pb
 from .proto import peers_pb2 as peers_pb
 from .store import CacheItem
@@ -64,11 +65,17 @@ class V1Instance:
 
     def __init__(self, config: Config, mesh=None, engine=None,
                  peer_tls_creds=None):
-        from .parallel import ShardedEngine, make_mesh
-
         self.config = config
         self.metrics = Metrics()
+        #: bounded structured-event ring (telemetry.py): wave launches/
+        #: stalls/timeouts, handover passes, GLOBAL broadcasts, errors —
+        #: served as JSON at the daemon's GET /debug/events
+        self.recorder = FlightRecorder()
         if engine is None:
+            # lazy: an injected engine (tests, alternative backends)
+            # must not drag the sharded/jax stack in
+            from .parallel import ShardedEngine, make_mesh
+
             m = mesh if mesh is not None else make_mesh()
             n = m.shape["shard"]
             cap_local = max(config.cache_size // n, 1024)
@@ -113,8 +120,11 @@ class V1Instance:
 
         # Cross-request coalescing: concurrent handler threads share
         # device launches instead of serializing on the engine lock
-        # (the worker-pool analog, see dispatcher.py).
-        self.dispatcher = Dispatcher(engine, lock=self._engine_mu)
+        # (the worker-pool analog, see dispatcher.py).  Wave telemetry
+        # lands on this instance's registry + recorder.
+        self.dispatcher = Dispatcher(engine, lock=self._engine_mu,
+                                     metrics=self.metrics,
+                                     recorder=self.recorder)
         self._peer_tls = peer_tls_creds
         # Datacenter-aware deployments route through a region picker
         # (region_picker.go); single-region uses the flat ring.
@@ -311,10 +321,14 @@ class V1Instance:
                             # a first RPC to a just-joined peer can
                             # exceed its deadline while that daemon
                             # compiles its upsert program; the upsert is
-                            # idempotent, so retrying is safe
+                            # idempotent, so retrying is safe.
+                            # exc_text: a deadline error str()s empty
                             log.warning("handover to %s failed "
                                         "(attempt %d/3): %s", addr,
-                                        attempt + 1, e)
+                                        attempt + 1, exc_text(e))
+                            self.recorder.record_error(
+                                "handover_error", e, peer=addr,
+                                attempt=attempt + 1)
                             time.sleep(0.5 * (attempt + 1))
                     if not delivered:
                         continue  # row stays: reset-on-rehome fallback
@@ -325,6 +339,8 @@ class V1Instance:
                     sent += len(chunk)
             log.info("handover: moved %d rows to %d peers", sent,
                      len(moved))
+            self.recorder.record("handover", rows=sent,
+                                 peers=len(moved))
 
     def peers(self) -> List[PeerClient]:
         with self._peer_mu:
@@ -889,10 +905,12 @@ class V1Instance:
                     error="peer_forward").inc(int(idxs.size))
                 z32 = np.zeros(idxs.size, np.int32)
                 z64 = np.zeros(idxs.size, np.int64)
+                # exc_text: a grpc deadline/TimeoutError str()s empty —
+                # the row must stay diagnosable (round-5 bug, repo-wide)
                 ebytes = _wire_native.build_rate_limit_resps(
                     z32, z64, z64, z64,
-                    [f"while fetching rate limit from peer: {err}"]
-                    * int(idxs.size))
+                    [f"while fetching rate limit from peer: "
+                     f"{exc_text(err)}"] * int(idxs.size))
                 eo, el, _ = _wire_native.split_resp_items(ebytes)
                 for j, i in enumerate(idxs):
                     item_tlvs[int(i)] = ebytes[int(eo[j]):int(eo[j] + el[j])]
@@ -1029,7 +1047,8 @@ class V1Instance:
                 self.metrics.check_error_counter.labels(
                     error="peer_forward").inc()
                 responses[i] = RateLimitResponse(
-                    error=f"while fetching rate limit from peer: {e}")
+                    error=f"while fetching rate limit from peer: "
+                          f"{exc_text(e)}")
         self._maybe_sweep(now)
         return responses  # type: ignore[return-value]
 

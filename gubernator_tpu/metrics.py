@@ -5,6 +5,10 @@ lrucache.go gauges, global.go queue/broadcast metrics — reconstructed)
 with the same metric names where sensible, so existing dashboards can be
 pointed at this service (SURVEY.md §5.5).  Each instance gets its own
 CollectorRegistry (multiple daemons per process in the test cluster).
+
+The full metric catalog lives in OBSERVABILITY.md; tools/check_metrics.py
+(a tier-1 test) asserts every metric registered here is documented there
+and that names are unique.
 """
 from __future__ import annotations
 
@@ -20,6 +24,16 @@ from prometheus_client import (
 )
 
 _BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5)
+
+#: wave durations reach minutes on a cold compile (250-305 s through
+#: the axon tunnel) — the histogram must resolve that tail, not clip it
+#: at 2.5 s, or the one event the watchdog exists for is invisible.
+_WAVE_DURATION_BUCKETS = _BUCKETS + (10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
+#: requests per coalesced wave: 1 (idle inline) up to max_wave (8192
+#: default) and beyond for merged packed columns
+_WAVE_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 1024, 4096,
+                      16384, 65536)
 
 
 class Metrics:
@@ -83,6 +97,42 @@ class Metrics:
             "fraction of 8-slot buckets that are FULL (pallas serving "
             "mode; new keys hashing into a full bucket are unservable)",
             registry=r)
+        # Dispatcher wave telemetry (ISSUE 1): the wave/queue/compile
+        # layer is the hot path and was previously unobservable — a
+        # 250-305 s cold compile surfaced only as an empty TimeoutError
+        # at the caller.  dispatcher.py observes these per wave.
+        self.wave_size = Histogram(
+            "gubernator_dispatcher_wave_size",
+            "requests per coalesced device wave",
+            buckets=_WAVE_SIZE_BUCKETS, registry=r)
+        self.wave_queue_wait = Histogram(
+            "gubernator_dispatcher_queue_wait",
+            "job wait from submit to its wave launching (s)",
+            buckets=_BUCKETS, registry=r)
+        self.wave_duration = Histogram(
+            "gubernator_dispatcher_wave_duration",
+            "device wave duration, launch to resolve (s); the tail "
+            "buckets exist for cold compiles",
+            buckets=_WAVE_DURATION_BUCKETS, registry=r)
+        self.waves_in_flight = Gauge(
+            "gubernator_dispatcher_waves_in_flight",
+            "waves currently executing on the device (incl. pipelined "
+            "launches awaiting sync)", registry=r)
+        self.wave_timeout_counter = Counter(
+            "gubernator_dispatcher_wave_timeouts",
+            "caller waits that hit RESULT_TIMEOUT_S", registry=r)
+        self.dispatcher_stalled = Gauge(
+            "gubernator_dispatcher_stalled",
+            "1 while any wave has been in flight longer than the stall "
+            "threshold (a cold compile shows here minutes before "
+            "callers time out)", registry=r)
+        self.stall_event_counter = Counter(
+            "gubernator_dispatcher_stall_events",
+            "waves flagged stalled by the watchdog", registry=r)
+        self.first_wave_duration = Gauge(
+            "gubernator_dispatcher_first_wave_seconds",
+            "duration of this dispatcher's FIRST wave (includes any "
+            "cold compile the warmup did not cover)", registry=r)
 
     @contextmanager
     def time_func(self, name: str):

@@ -322,3 +322,33 @@ class Oracle:
     def check_batch(self, reqs: List[RateLimitRequest], now_ms: int
                     ) -> List[RateLimitResponse]:
         return [self.check(r, now_ms) for r in reqs]
+
+
+class OracleEngine:
+    """The Oracle behind the V1Instance engine interface (hot-path
+    subset): lets the service layer — dispatcher coalescing, daemon
+    listeners, wave telemetry — run and be tested on pure Python, with
+    no jax/sharded stack at all.  Columnar and row-level ops are
+    deliberately absent: anything that needs them should use a real
+    engine.  Not thread-safe by itself; the dispatcher's engine lock
+    serializes access exactly as it does for device engines."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        self.oracle = Oracle()
+        self.cap_local = capacity
+        self.n = 1
+        self.dropped_rows = 0
+
+    def check_batch(self, reqs: List[RateLimitRequest], now_ms: int
+                    ) -> List[RateLimitResponse]:
+        return self.oracle.check_batch(list(reqs), now_ms)
+
+    def occupancy(self) -> int:
+        return len(self.oracle.items)
+
+    def sweep(self, now_ms: int) -> None:
+        self.oracle.items = {k: it for k, it in self.oracle.items.items()
+                             if it.expire_at >= now_ms}
+
+    def snapshot(self) -> dict:
+        return {}

@@ -186,6 +186,12 @@ class PeerClient:
                 fut.set_exception(
                     RuntimeError("peer returned short response batch"))
         except Exception as e:  # noqa: BLE001 - surfaced per-request
+            from .telemetry import exc_text
+
+            # exc_text: a flush deadline (grpc DEADLINE_EXCEEDED while
+            # the owner compiles) must not log as an empty string
+            log.warning("peer batch flush to %s failed (%d reqs): %s",
+                        self.info.grpc_address, len(batch), exc_text(e))
             for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)

@@ -1,0 +1,88 @@
+"""Flight recorder: a bounded in-memory ring of structured events.
+
+The round-5 bench window lost three whole sections to an *empty*
+``TimeoutError`` — the dispatcher had no record of what its waves were
+doing when the caller gave up (dispatcher.py › RESULT_TIMEOUT_S).  This
+module is the black box for that class of failure: every layer that can
+wedge (dispatcher waves, handover passes, GLOBAL broadcasts) records
+cheap structured events here, and the daemon exposes the ring as JSON at
+``GET /debug/events`` (``guber-cli debug events`` round-trips it).
+
+Events are plain dicts, JSON-safe by construction, ordered by a
+monotonic ``seq``.  The ring is bounded (old events fall off), so
+recording on the hot path is O(1) and allocation-light.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+
+def exc_text(e: BaseException) -> str:
+    """Non-empty error text for any exception.
+
+    ``str(e)`` is EMPTY for a bare ``TimeoutError`` (and friends) —
+    that is exactly how the round-5 undiagnosable rows happened.  Every
+    error-row / log / recorder path must go through this instead of
+    bare ``str(e)``: message when there is one, ``repr`` otherwise."""
+    return str(e) or repr(e)
+
+
+class FlightRecorder:
+    """Bounded ring of structured events (thread-safe).
+
+    Each event is ``{"seq": int, "t_ms": wall-clock ms, "kind": str,
+    "trace": trace-id-or-None, **fields}``.  Non-primitive field values
+    are coerced with ``repr`` so ``events()`` is always JSON-safe.
+    """
+
+    def __init__(self, capacity: int = 512, clock=time.time):
+        if capacity < 1:
+            raise ValueError("recorder capacity must be >= 1")
+        self.capacity = capacity
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+
+    def record(self, kind: str, trace: Optional[str] = None,
+               **fields) -> dict:
+        """Append one event; returns the stored dict.  ``trace``
+        defaults to the calling thread's active trace id (tracing.py),
+        so handler-path events correlate with W3C traceparent hops —
+        callers off the request path (worker/watchdog threads) pass the
+        trace they captured at submit time."""
+        if trace is None:
+            from .tracing import current_trace_id
+
+            trace = current_trace_id()
+        ev = {"kind": kind, "t_ms": int(self._clock() * 1000),
+              "trace": trace}
+        for k, v in fields.items():
+            if v is not None and not isinstance(v, (str, int, float, bool)):
+                v = repr(v)
+            ev[k] = v
+        with self._mu:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._ring.append(ev)
+        return ev
+
+    def record_error(self, kind: str, e: BaseException, **fields) -> dict:
+        """``record`` with the exception's non-empty text in ``error``."""
+        return self.record(kind, error=exc_text(e), **fields)
+
+    def events(self, limit: Optional[int] = None) -> List[dict]:
+        """Chronological snapshot (oldest first); ``limit`` keeps only
+        the newest N."""
+        with self._mu:
+            out = list(self._ring)
+        if limit is not None and limit >= 0:
+            out = out[len(out) - min(limit, len(out)):]
+        return out
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._ring)

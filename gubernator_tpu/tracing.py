@@ -71,6 +71,15 @@ def parse_traceparent(header: Optional[str]):
     return tid, flags
 
 
+def current_trace_id() -> Optional[str]:
+    """The active request's 32-hex trace id, or None outside any
+    request context.  Cheap enough for hot-path capture (the flight
+    recorder and dispatcher jobs stamp it at submit time — worker
+    threads have no request context of their own)."""
+    tp = getattr(_tls, "trace", None)
+    return tp[0] if tp is not None else None
+
+
 def current_traceparent() -> Optional[str]:
     """Outbound header for the active request's trace (fresh span id
     per hop), or None outside any request context."""

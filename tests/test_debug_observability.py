@@ -1,0 +1,189 @@
+"""End-to-end observability: /debug/events + deep /healthz + the CLI
+round trips, and the acceptance scenario — a simulated slow wave is
+DIAGNOSED (stalled gauge on /metrics, wave_stalled event with non-empty
+error/trace on /debug/events, telemetry block in the bench snapshot)
+before the caller's timeout fires."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.daemon import spawn_daemon
+from gubernator_tpu.netutil import free_port
+from gubernator_tpu.oracle import OracleEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRACE_ID = "ab" * 16
+TRACEPARENT = f"00-{TRACE_ID}-{'cd' * 8}-01"
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    # a tiny stall threshold so the watchdog (poll interval threshold/4)
+    # flags a slow wave within the test's injected 1.2 s engine delay
+    os.environ["GUBER_STALL_THRESHOLD_S"] = "0.25"
+    try:
+        # OracleEngine: the observability layer under test is engine-
+        # agnostic; the pure-Python engine keeps this e2e suite runnable
+        # without the jax sharded stack
+        d = spawn_daemon(DaemonConfig(
+            grpc_listen_address=f"127.0.0.1:{free_port()}",
+            http_listen_address=f"127.0.0.1:{free_port()}",
+            cache_size=1 << 10), engine=OracleEngine())
+    finally:
+        del os.environ["GUBER_STALL_THRESHOLD_S"]
+    yield d
+    d.close()
+
+
+def _get(daemon, path, timeout=10):
+    url = f"http://127.0.0.1:{daemon.http_port}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as f:
+        return f.read()
+
+
+def _post_check(daemon, key, timeout=60):
+    body = json.dumps({"requests": [{
+        "name": "obs", "unique_key": key, "hits": 1, "limit": 100,
+        "duration": 60_000}]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{daemon.http_port}/v1/GetRateLimits",
+        data=body, headers={"Content-Type": "application/json",
+                            "traceparent": TRACEPARENT})
+    with urllib.request.urlopen(req, timeout=timeout) as f:
+        return json.loads(f.read())
+
+
+def test_debug_events_round_trip_with_trace(daemon):
+    out = _post_check(daemon, "k_events")
+    assert out["responses"][0]["error"] == ""
+    body = json.loads(_get(daemon, "/debug/events"))
+    evs = body["events"]
+    kinds = {e["kind"] for e in evs}
+    assert "wave_launched" in kinds and "wave_completed" in kinds
+    # the HTTP handler's traceparent rode into the wave events
+    assert any(e.get("trace") == TRACE_ID for e in evs)
+    # ordering + limit
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    limited = json.loads(_get(daemon, "/debug/events?limit=2"))["events"]
+    assert len(limited) == 2 and limited[-1]["seq"] == seqs[-1]
+
+
+def test_healthz_deep_reports_dispatcher_state(daemon):
+    shallow = json.loads(_get(daemon, "/healthz"))
+    assert shallow["status"] == "healthy"
+    assert "dispatcher" not in shallow
+    deep = json.loads(_get(daemon, "/healthz?deep=1"))
+    disp = deep["dispatcher"]
+    for k in ("queue_depth", "in_flight", "last_wave_age_s", "stalled",
+              "waves", "stall_events", "timeouts", "stall_threshold_s",
+              "result_timeout_s"):
+        assert k in disp, k
+    assert disp["waves"] >= 1  # the daemon warmup wave at minimum
+    assert disp["stall_threshold_s"] == pytest.approx(0.25)
+
+
+def test_slow_wave_is_diagnosed_before_caller_timeout(daemon):
+    """The acceptance scenario: engine delay (1.2 s) > watchdog
+    threshold (0.25 s) but far below RESULT_TIMEOUT_S (120 s) — the
+    stall must be visible on /metrics and /debug/events WHILE the wave
+    is still in flight, and the caller must then succeed normally."""
+    import bench
+
+    inst = daemon.instance
+    eng = inst.engine
+    orig = eng.check_batch
+
+    def slow(reqs, now):
+        time.sleep(1.2)
+        return orig(reqs, now)
+
+    eng.check_batch = slow
+    result = {}
+    try:
+        t = threading.Thread(target=lambda: result.update(
+            _post_check(daemon, "k_slow")))
+        t.start()
+        # the gauge must flip while the wave is in flight
+        deadline = time.monotonic() + 10
+        flipped = False
+        while time.monotonic() < deadline:
+            text = _get(daemon, "/metrics").decode()
+            if "gubernator_dispatcher_stalled 1.0" in text:
+                flipped = True
+                break
+            time.sleep(0.05)
+        assert flipped, "stalled gauge never flipped on /metrics"
+        assert t.is_alive(), "diagnosis must precede the wave finishing"
+        evs = json.loads(_get(daemon, "/debug/events"))["events"]
+        stalls = [e for e in evs if e["kind"] == "wave_stalled"]
+        assert stalls, "no wave_stalled event on /debug/events"
+        assert stalls[-1]["error"], "stall event error field is empty"
+        assert stalls[-1]["trace"] == TRACE_ID, \
+            "stall event must carry the caller's trace id"
+        t.join(timeout=60)
+        # the caller did NOT time out: the stall was a diagnosis only
+        assert result["responses"][0]["error"] == ""
+    finally:
+        eng.check_batch = orig
+    # recovery: gauge clears once the wave completes
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ("gubernator_dispatcher_stalled 0.0"
+                in _get(daemon, "/metrics").decode()):
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("stalled gauge never cleared after recovery")
+    # ...and the bench telemetry block sees the same stall
+    snap = bench._telemetry_rows(inst)
+    assert snap["stall_events"] >= 1
+    assert snap["timeouts"] == 0
+    assert snap["wave_duration_p99_ms"] is not None
+    assert snap["wave_size_p50"] >= 1
+
+
+def test_cli_debug_events_subcommand(daemon):
+    _post_check(daemon, "k_cli")
+    r = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cli", "debug",
+         "events", "--url", f"http://127.0.0.1:{daemon.http_port}",
+         "--json"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    evs = json.loads(r.stdout)["events"]
+    assert any(e["kind"] == "wave_completed" for e in evs)
+    # human format + kind filter
+    r2 = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cli", "debug",
+         "events", "--url", f"http://127.0.0.1:{daemon.http_port}",
+         "--kind", "wave_completed", "--limit", "5"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 0, r2.stderr
+    lines = r2.stdout.strip().splitlines()
+    assert lines and all("wave_completed" in ln for ln in lines)
+
+
+def test_healthcheck_cli_deep(daemon):
+    r = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.healthcheck",
+         "--url", f"http://127.0.0.1:{daemon.http_port}/healthz",
+         "--deep"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "healthy" in r.stdout
+    assert "dispatcher:" in r.stdout
+    disp = json.loads(r.stdout.split("dispatcher:", 1)[1]
+                      .strip().splitlines()[0])
+    assert "queue_depth" in disp and "stalled" in disp

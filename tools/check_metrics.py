@@ -1,0 +1,79 @@
+"""Metric-catalog lint (tier-1 via tests/test_check_metrics.py).
+
+Asserts, against a fresh ``Metrics()`` registry:
+
+1. metric (family) names are unique — duplicate registration is a
+   silent dashboard breaker (prometheus_client raises on exact dups,
+   but two attributes pointing at lookalike names would not);
+2. every registered metric is documented in OBSERVABILITY.md;
+3. every ``gubernator_*`` name OBSERVABILITY.md documents actually
+   exists — a stale doc is how the metrics.py docstring drifted before.
+
+Exit 0 when clean; prints each violation and exits 1 otherwise.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DOC = os.path.join(REPO, "OBSERVABILITY.md")
+
+#: sample suffixes prometheus_client appends — doc names are family
+#: names, but a doc mentioning the exposition form shouldn't fail lint
+_SUFFIXES = ("_total", "_created", "_bucket", "_count", "_sum", "_info")
+
+
+def _canonical(name: str, reg_set) -> str:
+    """Map a documented name to its registered family: exact match
+    wins; otherwise strip ONE sample suffix if that base is registered
+    (family names themselves may legitimately end in _count etc., so a
+    blind strip would corrupt real names)."""
+    if name in reg_set:
+        return name
+    for s in _SUFFIXES:
+        if name.endswith(s) and name[: -len(s)] in reg_set:
+            return name[: -len(s)]
+    return name
+
+
+def main() -> int:
+    from gubernator_tpu.metrics import Metrics
+
+    m = Metrics()
+    registered = [fam.name for fam in m.registry.collect()]
+    problems = []
+
+    dups = {n for n in registered if registered.count(n) > 1}
+    if dups:
+        problems.append(f"duplicate metric names: {sorted(dups)}")
+
+    with open(DOC, encoding="utf-8") as f:
+        doc = f.read()
+    reg_set = set(registered)
+    # the lookahead drops path-like mentions ("gubernator_tpu/metrics.py")
+    documented = {_canonical(n, reg_set) for n in re.findall(
+        r"gubernator_[a-z0-9_]+(?![a-z0-9_/.])", doc)}
+
+    for name in sorted(reg_set - documented):
+        problems.append(
+            f"metric {name!r} is registered in metrics.py but missing "
+            f"from OBSERVABILITY.md")
+    for name in sorted(documented - reg_set):
+        problems.append(
+            f"OBSERVABILITY.md documents {name!r} but no such metric "
+            f"is registered (stale doc entry)")
+
+    if problems:
+        for p in problems:
+            print(f"check_metrics: {p}", file=sys.stderr)
+        return 1
+    print(f"check_metrics: OK ({len(reg_set)} metrics, all documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,123 @@ class RequestBatch(NamedTuple):
     now: jax.Array | np.ndarray | None = None  # int64 epoch ms, 0 = unset
 
 
+class WaveLease:
+    """One leased pair of packed upload matrices (a64 [8,m] i64,
+    a32 [3,m] i32) from a :class:`WaveBufferPool`.
+
+    The holder must call :meth:`release` on EVERY path (success, engine
+    raise, close) once the device launch has consumed the buffers —
+    jax copies host operands during dispatch, so release-after-launch
+    is safe.  A lease dropped without release is detected by the GC
+    hook: the pool counts it as a leak (``gubernator_wave_buffer_leaks``)
+    and reclaims the buffers, so a bug degrades to a counter, not an
+    unbounded allocation regression."""
+
+    __slots__ = ("a64", "a32", "_pool", "_released", "__weakref__")
+
+    def __init__(self, pool: "WaveBufferPool", a64, a32):
+        self._pool = pool
+        self.a64 = a64
+        self.a32 = a32
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._pool._return(self.a64, self.a32)
+
+    def __del__(self):  # pragma: no cover - exercised via gc in tests
+        if not self._released:
+            self._released = True
+            self._pool._record_leak()
+            self._pool._return(self.a64, self.a32)
+
+
+class WaveBufferPool:
+    """Ring of reusable packed wave-upload matrices, keyed by padded
+    wave width ``m`` (= n_shards × wave bucket).
+
+    The serving loop used to allocate a fresh [8,m] i64 + [3,m] i32
+    pair (~0.7 MB at the default big bucket) for EVERY device wave;
+    under the overlapped wave pipeline the same few shapes recur every
+    couple hundred microseconds, so the allocator/page-fault churn is
+    pure host-glue overhead (PERF.md §4.2).  ``lease(m)`` hands back a
+    pooled pair (zeroed to ``empty_batch`` padding semantics: all zeros,
+    ``eff_ms`` row = 1) or allocates on miss; ``WaveLease.release``
+    returns it.  Thread-safe; the per-width ring is bounded (pipeline
+    depth + a small margin) so a burst of odd widths cannot grow the
+    pool without bound.
+
+    ``metrics`` may be bound post-construction (V1Instance does) to a
+    ``Metrics`` registry carrying ``wave_buffer_pool_hit`` /
+    ``wave_buffer_pool_miss`` / ``wave_buffer_leaks`` counters.
+    """
+
+    #: pooled buffers kept per width — covers pipeline depth K plus the
+    #: wave being packed while K are in flight
+    MAX_PER_WIDTH = 4
+
+    def __init__(self, max_per_width: int | None = None):
+        import threading
+
+        self._mu = threading.Lock()
+        self._free: dict[int, list] = {}  # m → [(a64, a32), ...]
+        self.max_per_width = (max_per_width if max_per_width is not None
+                              else self.MAX_PER_WIDTH)
+        self.hits = 0
+        self.misses = 0
+        self.leaks = 0
+        self.outstanding = 0
+        self.metrics = None  # bound by V1Instance after construction
+
+    def lease(self, m: int) -> WaveLease:
+        """Lease a zeroed (a64 [8,m] i64, a32 [3,m] i32) pair.  Padding
+        rows keep ``empty_batch`` semantics: zeros everywhere, eff_ms 1
+        (the eff_ms re-fill is the caller's job — ``_fill_packed``
+        writes that row for every slot it doesn't scatter)."""
+        with self._mu:
+            ring = self._free.get(m)
+            buf = ring.pop() if ring else None
+            if buf is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+            self.outstanding += 1
+        if buf is not None:
+            a64, a32 = buf
+            a64.fill(0)
+            a32.fill(0)
+            if self.metrics is not None:
+                self.metrics.wave_buffer_pool_hit.inc()
+        else:
+            a64 = np.zeros((8, m), np.int64)
+            a32 = np.zeros((3, m), np.int32)
+            if self.metrics is not None:
+                self.metrics.wave_buffer_pool_miss.inc()
+        return WaveLease(self, a64, a32)
+
+    def _return(self, a64, a32) -> None:
+        m = a64.shape[1]
+        with self._mu:
+            self.outstanding -= 1
+            ring = self._free.setdefault(m, [])
+            if len(ring) < self.max_per_width:
+                ring.append((a64, a32))
+
+    def _record_leak(self) -> None:
+        with self._mu:
+            self.leaks += 1
+        if self.metrics is not None:
+            self.metrics.wave_buffer_leaks.inc()
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"hits": self.hits, "misses": self.misses,
+                    "leaks": self.leaks, "outstanding": self.outstanding,
+                    "pooled": sum(len(v) for v in self._free.values())}
+
+
 def bucket_size(n: int) -> int:
     for b in BATCH_BUCKETS:
         if n <= b:

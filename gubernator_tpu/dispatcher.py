@@ -45,6 +45,37 @@ def _concat_columns(parts):
     return batch, np.concatenate([kh for _, kh in parts])
 
 
+class ResultView:
+    """Row-slice view [lo, hi) into a wave's SHARED downloaded result
+    columns (status i32, limit i64, remaining i64, reset i64, full
+    bool).
+
+    The worker thread resolves each job's future with one of these —
+    two ints and a tuple reference — instead of materializing per-job
+    column tuples, so everything downstream of the device download
+    (slicing, over-limit counting, wire-byte serialization) runs in the
+    CALLER's thread, off the single dispatch loop.  Unpacking iterates
+    the five sliced columns, so ``st, lim, rem, rst, full = view``
+    keeps working at every legacy call site."""
+
+    __slots__ = ("cols", "lo", "hi")
+
+    def __init__(self, cols, lo: int, hi: int):
+        self.cols = cols
+        self.lo = lo
+        self.hi = hi
+
+    def sliced(self) -> tuple:
+        lo, hi = self.lo, self.hi
+        return tuple(c[lo:hi] for c in self.cols)
+
+    def __iter__(self):
+        return iter(self.sliced())
+
+    def __len__(self) -> int:
+        return 5
+
+
 class _Job:
     __slots__ = ("reqs", "now_ms", "future", "t_enq", "trace")
 
@@ -92,13 +123,37 @@ class Dispatcher:
     #: overrides; <= 0 disables the watchdog.
     STALL_THRESHOLD_S = 30.0
 
+    #: default depth of the overlapped wave pipeline: how many launched
+    #: waves may be in flight (unsynced) at once.  Depth 2 = pack wave
+    #: N+1 while wave N runs; GUBER_PIPELINE_DEPTH overrides (min 1 —
+    #: depth 1 degenerates to launch-then-sync, i.e. no overlap).
+    PIPELINE_DEPTH = 2
+
     def __init__(self, engine, max_wave: int = 8192,
                  max_delay_ms: float = 0.2,
                  lock: Optional[threading.Lock] = None,
                  metrics=None, recorder=None, clock=time.monotonic):
         self.engine = engine
         self.max_wave = max_wave
+        # coalescing window: how long the worker waits for more jobs
+        # after the first before launching the wave.  GUBER_COALESCE_US
+        # (microseconds) overrides the constructor default; malformed
+        # or negative values keep it.  _drain_wave skips the wait
+        # entirely when the queue already holds >= max_wave rows.
+        coalesce_env = os.environ.get("GUBER_COALESCE_US", "")
+        if coalesce_env:
+            try:
+                max_delay_ms = max(float(coalesce_env), 0.0) / 1000.0
+            except ValueError:
+                pass  # malformed: keep the constructor default
         self.max_delay_s = max_delay_ms / 1000.0
+        # overlapped-pipeline depth (in-flight launched waves)
+        depth_env = os.environ.get("GUBER_PIPELINE_DEPTH", "")
+        try:
+            depth = int(depth_env) if depth_env else self.PIPELINE_DEPTH
+        except ValueError:
+            depth = self.PIPELINE_DEPTH
+        self.pipeline_depth = max(depth, 1)
         #: per-instance Metrics registry (metrics.py) and FlightRecorder
         #: (telemetry.py); both optional — a bare Dispatcher (tests,
         #: library use) pays only the cheap internal counters.
@@ -126,6 +181,10 @@ class Dispatcher:
         #: same engine state.
         self._engine_lock = lock if lock is not None else threading.Lock()
         self._queue: "queue.Queue[_Job]" = queue.Queue()
+        #: worker-local holdover: the job that would have pushed the
+        #: current wave past max_wave leads the next wave instead
+        #: (only the dispatch thread touches it)
+        self._carry = None
         self._closing = threading.Event()
         self._submit_mu = threading.Lock()  # serializes submit vs close
         #: one idle-path inline runner at a time (see _try_inline)
@@ -136,6 +195,9 @@ class Dispatcher:
         #: fast path to a pipeline that can't exist)
         self._pipelined = (self._want_pipeline()
                            and hasattr(engine, "launch_packed"))
+        if self.metrics is not None:
+            self.metrics.pipeline_depth.set(
+                self.pipeline_depth if self._pipelined else 0)
         env_timeout = os.environ.get("GUBER_RESULT_TIMEOUT_S", "")
         if env_timeout:
             try:
@@ -177,10 +239,11 @@ class Dispatcher:
 
     @staticmethod
     def _want_pipeline() -> bool:
-        """Launch/sync pipelining (depth 2) is TPU-only by default: the
-        CPU backend effectively serializes dispatch, so splitting
-        launch/sync there just adds overhead (measured 644k → 227k
-        dec/s at 16 callers).  GUBER_PIPELINE=1/0 overrides."""
+        """Launch/sync pipelining (depth K, see pipeline_depth) is
+        TPU-only by default: the CPU backend effectively serializes
+        dispatch, so splitting launch/sync there just adds overhead
+        (measured 644k → 227k dec/s at 16 callers).
+        GUBER_PIPELINE=1/0 overrides."""
         import os
 
         pipe_env = os.environ.get("GUBER_PIPELINE", "")
@@ -250,7 +313,18 @@ class Dispatcher:
     def check_packed(self, batch, khash, now_ms: int) -> tuple:
         """Columnar submit (see engine.check_packed); coalesces with
         other packed callers by column concatenation.  Idle → inline
-        (a lone packed job's wave is exactly engine.check_packed)."""
+        (a lone packed job's wave is exactly engine.check_packed).
+        Returns the classic 5-tuple of per-request columns; the
+        slicing out of the wave's shared result columns happens HERE,
+        in the caller's thread (see ResultView)."""
+        return self.check_packed_view(batch, khash, now_ms).sliced()
+
+    def check_packed_view(self, batch, khash, now_ms: int) -> ResultView:
+        """``check_packed`` returning the zero-copy ResultView: row
+        bounds into the wave's shared downloaded result columns.  The
+        wire lanes serialize straight from the view (ops/_native.cpp ›
+        build_responses_from_columns) without materializing per-job
+        column tuples."""
         if self._try_inline():
             try:
                 wid = self._wave_begin("inline_packed", nreq=len(khash))
@@ -262,7 +336,7 @@ class Dispatcher:
                     self._wave_end(wid, error=e)
                     raise
                 self._wave_end(wid)
-                return out
+                return ResultView(out, 0, len(khash))
             finally:
                 self._inline_mu.release()
         job = _PackedJob(batch, khash, now_ms)
@@ -294,7 +368,8 @@ class Dispatcher:
     # costs two dict ops and a few deque appends per wave.
 
     def _wave_begin(self, kind: str, jobs=None, nreq: int = 0,
-                    trace: Optional[str] = None) -> int:
+                    trace: Optional[str] = None,
+                    slot: Optional[int] = None) -> int:
         t0 = self._clock()
         waits = []
         if jobs:
@@ -314,7 +389,8 @@ class Dispatcher:
             self._wave_seq += 1
             wid = self._wave_seq
             self._inflight[wid] = {"t0": t0, "kind": kind, "size": nreq,
-                                   "trace": trace, "stalled": False}
+                                   "trace": trace, "stalled": False,
+                                   "slot": slot}
             self._recent_sizes.append(nreq)
             self._recent_waits.extend(waits)
         if self.metrics is not None:
@@ -323,9 +399,13 @@ class Dispatcher:
                 self.metrics.wave_queue_wait.observe(w)
             self.metrics.waves_in_flight.inc()
         if self.recorder is not None:
-            self.recorder.record("wave_launched", trace=trace, wave=wid,
-                                 wave_kind=kind, size=nreq,
-                                 jobs=len(jobs) if jobs else 1)
+            ev = {"trace": trace, "wave": wid, "wave_kind": kind,
+                  "size": nreq, "jobs": len(jobs) if jobs else 1}
+            if slot is not None:
+                # pipeline slot this launch occupies (0 = the oldest
+                # in-flight wave) — correlates stalls with ring depth
+                ev["slot"] = slot
+            self.recorder.record("wave_launched", **ev)
         return wid
 
     def _wave_end(self, wid: int, error: Optional[BaseException] = None
@@ -363,6 +443,8 @@ class Dispatcher:
             ev = {"trace": info["trace"], "wave": wid,
                   "wave_kind": info["kind"], "size": info["size"],
                   "duration_ms": round(dur * 1000, 3)}
+            if info.get("slot") is not None:
+                ev["slot"] = info["slot"]
             if error is not None:
                 self.recorder.record("wave_error", error=exc_text(error),
                                      **ev)
@@ -464,6 +546,14 @@ class Dispatcher:
                              if first is not None else None),
             "stall_threshold_s": self._stall_threshold_s,
             "result_timeout_s": self.RESULT_TIMEOUT_S,
+            # overlapped-pipeline shape: 0 when the pipeline is off
+            # (CPU default / capability-less engine), else the depth-K
+            # in-flight bound (GUBER_PIPELINE_DEPTH)
+            "pipeline_depth": (self.pipeline_depth if self._pipelined
+                               else 0),
+            "buffer_pool": (self.engine.wave_pool.stats()
+                            if hasattr(self.engine, "wave_pool")
+                            else None),
         }
 
     def telemetry_snapshot(self) -> dict:
@@ -497,54 +587,86 @@ class Dispatcher:
 
     def _drain_wave(self, block_s: float = 0.1) -> List[_Job]:
         """Block for one job (up to ``block_s``), then collect more for
-        up to max_delay_ms (bounded by max_wave total requests) so
-        bursty concurrent callers share the next device launch."""
-        try:
-            first = (self._queue.get(timeout=block_s) if block_s > 0
-                     else self._queue.get_nowait())
-        except queue.Empty:
-            return []
+        up to the coalescing window (GUBER_COALESCE_US, bounded by
+        max_wave total requests) so bursty concurrent callers share the
+        next device launch.  Jobs already queued are taken greedily
+        FIRST: when the backlog alone fills max_wave rows, the wave
+        launches with NO coalescing wait at all — the window exists to
+        catch stragglers, not to tax a saturated queue."""
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        else:
+            try:
+                first = (self._queue.get(timeout=block_s) if block_s > 0
+                         else self._queue.get_nowait())
+            except queue.Empty:
+                return []
         wave = [first]
         total = _job_len(first)
-        deadline = time.monotonic() + self.max_delay_s
+        deadline = None  # armed only after the backlog is drained
         while total < self.max_wave:
-            remain = deadline - time.monotonic()
             try:
-                job = (self._queue.get(timeout=remain) if remain > 0
-                       else self._queue.get_nowait())
+                job = self._queue.get_nowait()
             except queue.Empty:
+                if self.max_delay_s <= 0:
+                    break
+                if deadline is None:
+                    deadline = time.monotonic() + self.max_delay_s
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                try:
+                    job = self._queue.get(timeout=remain)
+                except queue.Empty:
+                    break
+            if total + _job_len(job) > self.max_wave:
+                # never overshoot max_wave: an oversized wave splits
+                # into one dense launch + a sparse tail launch at the
+                # small bucket — the tail's fixed per-launch cost is
+                # pure waste.  The job that would overflow leads the
+                # NEXT wave instead.
+                self._carry = job
                 break
             wave.append(job)
             total += _job_len(job)
         return wave
 
     def _run(self) -> None:
-        # Launch/sync pipeline (depth 2) for pure-packed waves: wave K's
-        # device time overlaps wave K+1's host assembly — launches are
-        # ordered by the state threading device-side, so correctness
-        # does not depend on when results are read.  Mixed/list waves
-        # flush the pipeline first (bounded caller latency).  The
-        # TPU/CPU policy lives in _want_pipeline (shared with the
-        # inline fast path's gate).
+        # Overlapped wave pipeline (depth K = pipeline_depth,
+        # GUBER_PIPELINE_DEPTH) for pure-packed waves: while up to K
+        # launched waves are in flight on the device, the worker drains
+        # and PACKS the next wave into a pooled upload buffer
+        # (core/batch.py › WaveBufferPool via engine._fill_packed) —
+        # steady-state throughput becomes max(host, device) instead of
+        # host + device.  Launches are ordered by the state threading
+        # device-side, so correctness does not depend on when results
+        # are read; completion resolves strictly oldest-first (the
+        # in-flight ring is FIFO), preserving per-job splice order.
+        # Mixed/list waves flush the pipeline first (bounded caller
+        # latency).  The TPU/CPU policy lives in _want_pipeline (shared
+        # with the inline fast path's gate).
         from collections import deque
 
         pipelined = self._pipelined
+        depth = self.pipeline_depth
         pending: deque = deque()  # [(jobs, token)] launched, unsynced
 
         def flush_pending() -> None:
             while pending:
                 self._sync_and_resolve(*pending.popleft())
 
-        while not (self._closing.is_set() and self._queue.empty()):
+        while not (self._closing.is_set() and self._queue.empty()
+                   and self._carry is None):
             wave = self._drain_wave(block_s=0.0 if pending else 0.1)
             if not wave:
                 flush_pending()
                 continue
             if pipelined and all(isinstance(j, _PackedJob) for j in wave):
-                launched = self._launch_packed_jobs(wave)
+                launched = self._launch_packed_jobs(wave,
+                                                    slot=len(pending))
                 if launched is not None:
                     pending.append(launched)
-                    if len(pending) >= 2:
+                    while len(pending) >= depth:
                         self._sync_and_resolve(*pending.popleft())
                 continue
             flush_pending()
@@ -590,12 +712,13 @@ class Dispatcher:
         while pending:
             self._sync_and_resolve(*pending.popleft())
 
-    def _launch_packed_jobs(self, jobs):
+    def _launch_packed_jobs(self, jobs, slot: Optional[int] = None):
         """Concat + LAUNCH a pure-packed wave; returns (jobs, token,
         wave_id) for the sync phase, or None when dispatch failed
         (futures already resolved with the error).  The wave stays "in
-        flight" (watchdog-visible) from launch until its sync resolves."""
-        wid = self._wave_begin("packed_pipelined", jobs)
+        flight" (watchdog-visible) from launch until its sync resolves;
+        ``slot`` is its position in the in-flight ring at launch."""
+        wid = self._wave_begin("packed_pipelined", jobs, slot=slot)
         try:
             if len(jobs) == 1:
                 batch, khash = jobs[0].batch, jobs[0].khash
@@ -620,7 +743,9 @@ class Dispatcher:
             a = 0
             for j in jobs:
                 b = a + len(j.khash)
-                j.future.set_result(tuple(c[a:b] for c in cols))
+                # a row-bounds view, NOT materialized slices: response
+                # build runs in each caller's own thread (ResultView)
+                j.future.set_result(ResultView(cols, a, b))
                 a = b
             self._wave_end(wid)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
@@ -669,11 +794,11 @@ class Dispatcher:
             st, lim, rem, rst, full = self.engine.check_packed(
                 batch, khash, now)
         a = 0
+        cols = (st, lim, rem, rst, full)
         for j, _, kh, errs in parts:
             b_ = a + len(kh)
             if isinstance(j, _PackedJob):
-                j.future.set_result((st[a:b_], lim[a:b_], rem[a:b_],
-                                     rst[a:b_], full[a:b_]))
+                j.future.set_result(ResultView(cols, a, b_))
             else:
                 j.future.set_result(responses_from_columns(
                     (st[a:b_], lim[a:b_], rem[a:b_], rst[a:b_],
@@ -722,7 +847,7 @@ class Dispatcher:
             a = 0
             for j in jobs:
                 b = a + len(j.khash)
-                j.future.set_result(tuple(c[a:b] for c in cols))
+                j.future.set_result(ResultView(cols, a, b))
                 a = b
             self._wave_end(wid)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller

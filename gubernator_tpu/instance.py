@@ -125,6 +125,12 @@ class V1Instance:
         self.dispatcher = Dispatcher(engine, lock=self._engine_mu,
                                      metrics=self.metrics,
                                      recorder=self.recorder)
+        # wave-buffer pool counters (hit/miss/leak) land on this
+        # instance's registry; the pool lives engine-side (lease scope
+        # is the engine's fill→launch window)
+        pool = getattr(engine, "wave_pool", None)
+        if pool is not None:
+            pool.metrics = self.metrics
         self._peer_tls = peer_tls_creds
         # Datacenter-aware deployments route through a region picker
         # (region_picker.go); single-region uses the flat ring.
@@ -744,13 +750,20 @@ class V1Instance:
                                ) -> bytes:
         """Columns → pack → device step → response wire bytes: the
         shared fast-lane body (solo client wire, peer wire, and the
-        clustered lane's local sub-batch all end here)."""
+        clustered lane's local sub-batch all end here).  Resolves from
+        the dispatcher's ResultView — row bounds into the wave's shared
+        downloaded result columns — and serializes straight from them
+        in THIS caller's thread (ops/_native.cpp ›
+        build_responses_from_columns), so response build never runs on
+        the dispatch worker and materializes no per-job column
+        tuples."""
         from .core.batch import pack_columns
 
         batch, errs = pack_columns(kh, hits, limit, duration, algorithm,
                                    behavior, burst, now)
-        status, lim, rem, rst, full = self.dispatcher.check_packed(
-            batch, kh, now)
+        view = self.dispatcher.check_packed_view(batch, kh, now)
+        status = view.cols[0][view.lo:view.hi]
+        full = view.cols[4][view.lo:view.hi]
         self.metrics.over_limit_counter.inc(int((status == 1).sum()))
         errors = None
         if errs or full.any():
@@ -762,8 +775,8 @@ class V1Instance:
             for i in np.nonzero(full)[0]:
                 if errors[int(i)] is None:
                     errors[int(i)] = "rate limit table full"
-        return _wire_native.build_rate_limit_resps(
-            status, lim, rem, rst, errors)
+        return _wire_native.build_responses_from_columns(
+            view.cols, view.lo, view.hi, errors)
 
     def _wire_check_columns(self, parsed: dict, now: int) -> bytes:
         """Parsed wire columns → device step → serialized responses

@@ -133,6 +133,29 @@ class Metrics:
             "gubernator_dispatcher_first_wave_seconds",
             "duration of this dispatcher's FIRST wave (includes any "
             "cold compile the warmup did not cover)", registry=r)
+        # Overlapped wave pipeline + wave-buffer pool (ISSUE 2): the
+        # depth-K in-flight ring and the pooled packed-upload matrices
+        # are new perf-critical moving parts — export their shape and
+        # churn so a regression (pool thrash, a leaked lease, an
+        # unexpected depth) is visible on /metrics.
+        self.pipeline_depth = Gauge(
+            "gubernator_dispatcher_pipeline_depth",
+            "configured depth of the overlapped wave pipeline (0 = "
+            "pipeline off: CPU default or capability-less engine)",
+            registry=r)
+        self.wave_buffer_pool_hit = Counter(
+            "gubernator_wave_buffer_pool_hits",
+            "wave upload-buffer leases served from the pool",
+            registry=r)
+        self.wave_buffer_pool_miss = Counter(
+            "gubernator_wave_buffer_pool_misses",
+            "wave upload-buffer leases that allocated fresh matrices",
+            registry=r)
+        self.wave_buffer_leaks = Counter(
+            "gubernator_wave_buffer_leaks",
+            "wave buffer leases dropped without release (reclaimed by "
+            "the GC hook; must stay 0 — asserted by the soak tests)",
+            registry=r)
 
     @contextmanager
     def time_func(self, name: str):

@@ -11,6 +11,8 @@
 //   fnv1a64_pair_batch(names, keys) -> (bytes, n)   hash(name + "_" + key)
 //   parse_get_rate_limits(bytes) -> None | tuple    wire -> packed columns
 //   build_rate_limit_resps(...) -> bytes            packed columns -> wire
+//   build_responses_from_columns(...) -> bytes      shared-column rows
+//                                                   [lo, hi) -> wire
 //
 // The avalanche finalizer stays in Python/numpy (hashing.mix64_np) so
 // there is exactly one source of truth for it.
@@ -424,55 +426,34 @@ static inline void put_field_varint(std::vector<uint8_t>& out, int field,
   put_varint(out, v);
 }
 
-// build_rate_limit_resps(status i32le, limit i64le, remaining i64le,
-//                        reset_time i64le, errors|None) -> bytes
-// errors: sequence of str/None per response (None/"" = no error field).
-static PyObject* build_rate_limit_resps(PyObject*, PyObject* args) {
-  Py_buffer st, li, re, rt;
-  PyObject* errors;
-  if (!PyArg_ParseTuple(args, "y*y*y*y*O", &st, &li, &re, &rt, &errors))
-    return nullptr;
-  Py_ssize_t n = st.len / 4;
-  if (li.len != n * 8 || re.len != n * 8 || rt.len != n * 8) {
-    PyBuffer_Release(&st);
-    PyBuffer_Release(&li);
-    PyBuffer_Release(&re);
-    PyBuffer_Release(&rt);
-    PyErr_SetString(PyExc_ValueError, "column length mismatch");
-    return nullptr;
-  }
-  const int32_t* status = (const int32_t*)st.buf;
-  const int64_t* limit = (const int64_t*)li.buf;
-  const int64_t* remaining = (const int64_t*)re.buf;
-  const int64_t* reset_time = (const int64_t*)rt.buf;
+// Shared serialization core: rows [lo, hi) of the given columns →
+// GetRateLimitsResp wire bytes.  ``errors`` (or Py_None) is indexed
+// RELATIVE to lo (errors[0] belongs to row lo).  Returns nullptr with
+// a Python error set on failure.
+static PyObject* build_resp_rows(const int32_t* status,
+                                 const int64_t* limit,
+                                 const int64_t* remaining,
+                                 const int64_t* reset_time,
+                                 Py_ssize_t lo, Py_ssize_t hi,
+                                 PyObject* errors) {
   std::vector<uint8_t> out;
-  out.reserve((size_t)n * 24);
+  out.reserve((size_t)(hi - lo) * 24);
   std::vector<uint8_t> sub;
   bool have_errors = errors != Py_None;
-  for (Py_ssize_t i = 0; i < n; i++) {
+  for (Py_ssize_t i = lo; i < hi; i++) {
     sub.clear();
     put_field_varint(sub, 1, (uint64_t)(uint32_t)status[i]);
     put_field_varint(sub, 2, (uint64_t)limit[i]);
     put_field_varint(sub, 3, (uint64_t)remaining[i]);
     put_field_varint(sub, 4, (uint64_t)reset_time[i]);
     if (have_errors) {
-      PyObject* e = PySequence_GetItem(errors, i);
-      if (e == nullptr) {
-        PyBuffer_Release(&st);
-        PyBuffer_Release(&li);
-        PyBuffer_Release(&re);
-        PyBuffer_Release(&rt);
-        return nullptr;
-      }
+      PyObject* e = PySequence_GetItem(errors, i - lo);
+      if (e == nullptr) return nullptr;
       if (e != Py_None) {
         const unsigned char* ep;
         Py_ssize_t elen;
         if (!utf8_view(e, &ep, &elen)) {
           Py_DECREF(e);
-          PyBuffer_Release(&st);
-          PyBuffer_Release(&li);
-          PyBuffer_Release(&re);
-          PyBuffer_Release(&rt);
           return nullptr;
         }
         if (elen > 0) {
@@ -487,12 +468,66 @@ static PyObject* build_rate_limit_resps(PyObject*, PyObject* args) {
     put_varint(out, (uint64_t)sub.size());
     out.insert(out.end(), sub.begin(), sub.end());
   }
+  return PyBytes_FromStringAndSize((const char*)out.data(),
+                                   (Py_ssize_t)out.size());
+}
+
+// build_rate_limit_resps(status i32le, limit i64le, remaining i64le,
+//                        reset_time i64le, errors|None) -> bytes
+// errors: sequence of str/None per response (None/"" = no error field).
+static PyObject* build_rate_limit_resps(PyObject*, PyObject* args) {
+  Py_buffer st, li, re, rt;
+  PyObject* errors;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*O", &st, &li, &re, &rt, &errors))
+    return nullptr;
+  Py_ssize_t n = st.len / 4;
+  PyObject* out = nullptr;
+  if (li.len != n * 8 || re.len != n * 8 || rt.len != n * 8) {
+    PyErr_SetString(PyExc_ValueError, "column length mismatch");
+  } else {
+    out = build_resp_rows((const int32_t*)st.buf, (const int64_t*)li.buf,
+                          (const int64_t*)re.buf, (const int64_t*)rt.buf,
+                          0, n, errors);
+  }
   PyBuffer_Release(&st);
   PyBuffer_Release(&li);
   PyBuffer_Release(&re);
   PyBuffer_Release(&rt);
-  return PyBytes_FromStringAndSize((const char*)out.data(),
-                                   (Py_ssize_t)out.size());
+  return out;
+}
+
+// build_responses_from_columns(status i32le, limit i64le,
+//                              remaining i64le, reset_time i64le,
+//                              row_lo, row_hi, errors|None) -> bytes
+// The overlapped-pipeline caller-thread lane: the columns are a wave's
+// SHARED result buffers (every job of the wave passes the same ones),
+// and [row_lo, row_hi) selects this caller's rows — wire bytes are
+// written straight from the packed result slice with zero per-request
+// Python objects and zero intermediate slices.  ``errors`` is indexed
+// relative to row_lo.
+static PyObject* build_responses_from_columns(PyObject*, PyObject* args) {
+  Py_buffer st, li, re, rt;
+  Py_ssize_t lo, hi;
+  PyObject* errors;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*nnO", &st, &li, &re, &rt, &lo, &hi,
+                        &errors))
+    return nullptr;
+  Py_ssize_t n = st.len / 4;
+  PyObject* out = nullptr;
+  if (li.len != n * 8 || re.len != n * 8 || rt.len != n * 8) {
+    PyErr_SetString(PyExc_ValueError, "column length mismatch");
+  } else if (lo < 0 || hi < lo || hi > n) {
+    PyErr_SetString(PyExc_ValueError, "row bounds out of range");
+  } else {
+    out = build_resp_rows((const int32_t*)st.buf, (const int64_t*)li.buf,
+                          (const int64_t*)re.buf, (const int64_t*)rt.buf,
+                          lo, hi, errors);
+  }
+  PyBuffer_Release(&st);
+  PyBuffer_Release(&li);
+  PyBuffer_Release(&re);
+  PyBuffer_Release(&rt);
+  return out;
 }
 
 static PyMethodDef methods[] = {
@@ -506,6 +541,10 @@ static PyMethodDef methods[] = {
      "RateLimitResp-list wire bytes -> per-item TLV ranges + status"},
     {"build_rate_limit_resps", build_rate_limit_resps, METH_VARARGS,
      "Packed response columns -> GetRateLimitsResp wire bytes"},
+    {"build_responses_from_columns", build_responses_from_columns,
+     METH_VARARGS,
+     "Rows [lo, hi) of shared result columns -> GetRateLimitsResp "
+     "wire bytes"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native",

@@ -76,3 +76,25 @@ def build_rate_limit_resps(status: np.ndarray, limit: np.ndarray,
         np.ascontiguousarray(remaining, "<i8"),
         np.ascontiguousarray(reset_time, "<i8"),
         errors if errors is not None else None)
+
+
+def build_responses_from_columns(result_cols, row_lo: int, row_hi: int,
+                                 errors=None) -> bytes:
+    """Rows [row_lo, row_hi) of a wave's SHARED result columns →
+    GetRateLimitsResp wire bytes, with zero per-request Python objects
+    and zero intermediate slices — the caller-thread response-build
+    lane of the overlapped wave pipeline (dispatcher.ResultView).
+
+    ``result_cols`` is the dispatcher/engine 5-tuple (status i32,
+    limit i64, remaining i64, reset i64, table_full bool); the bool
+    column is ignored here (the caller folds it into ``errors``).
+    ``errors``: optional sequence of str/None indexed relative to
+    ``row_lo``."""
+    st, lim, rem, rst = result_cols[:4]
+    return _native.build_responses_from_columns(
+        np.ascontiguousarray(st, "<i4"),
+        np.ascontiguousarray(lim, "<i8"),
+        np.ascontiguousarray(rem, "<i8"),
+        np.ascontiguousarray(rst, "<i8"),
+        int(row_lo), int(row_hi),
+        errors if errors is not None else None)

@@ -61,14 +61,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..core.batch import (RequestBatch, clamp_config,
                           empty_batch, pack_requests)
 from ..core.step import decide_batch_impl
 from ..core.table import TableState, init_table
 from ..types import EFF_MAX, RateLimitRequest, RateLimitResponse, Status
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 
 
 def _rep(mesh):

@@ -18,6 +18,44 @@ from ..core.table import TableState, init_table
 
 SHARD_AXIS = "shard"
 
+#: Process-wide gate around synchronous XLA executions.  This image's
+#: XLA:CPU wedges indefinitely when SEVERAL engines (the in-process
+#: multi-daemon test clusters) execute jitted programs concurrently
+#: from different threads — observed as every daemon's handler stuck
+#: inside the step call (tests/test_soak_wire.py, faulthandler dump).
+#: Per-instance engine locks can't prevent that cross-engine overlap;
+#: this mutex does.  Single-engine processes (the production topology)
+#: already serialize device work on their own engine lock, so the gate
+#: is uncontended there.
+import threading as _threading
+
+XLA_EXEC_MU = _threading.Lock()
+
+try:  # jax >= 0.5 exports shard_map at top level (check_vma kwarg)
+    from jax import shard_map as _shard_map_impl
+
+    _VMA_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _VMA_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable ``shard_map``: the repo targets the public
+    ``jax.shard_map`` API (``check_vma``); on jax 0.4.x images the same
+    call routes to ``jax.experimental.shard_map`` (whose equivalent
+    kwarg is ``check_rep``)."""
+    if check_vma is None and _VMA_KW == "check_rep":
+        # 0.4.x replication checking has no rule for lax.while_loop
+        # (the decision step's per-position fallback); the upstream
+        # workaround is check_rep=False — purely a static checker, so
+        # disabling it changes no computed values
+        check_vma = False
+    kw = {} if check_vma is None else {_VMA_KW: check_vma}
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)
+
 
 def make_mesh(devices: Sequence[jax.Device] | None = None,
               n: int | None = None) -> Mesh:

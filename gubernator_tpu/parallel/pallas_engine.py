@@ -36,12 +36,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.batch import RequestBatch
 from ..ops import pallas_step as ps
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 from .sharded import PACK32, PACK64, ShardedEngine
 
 #: SoA column → (word extractor) mapping used by snapshot/gather.

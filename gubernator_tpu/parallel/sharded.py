@@ -20,14 +20,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..hashing import shard_of
 from ..types import RateLimitRequest, RateLimitResponse, Status
-from ..core.batch import RequestBatch, empty_batch, pack_requests
+from ..core.batch import (RequestBatch, WaveBufferPool, empty_batch,
+                          pack_requests)
 from ..core.step import decide_batch_impl, _insert, _lookup, _probe_slots
 from ..core.table import TableState, init_table
-from .mesh import SHARD_AXIS, make_mesh, shard_table, table_sharding
+from .mesh import (SHARD_AXIS, XLA_EXEC_MU, make_mesh, shard_map,
+                   shard_table, table_sharding)
 
 log = logging.getLogger("gubernator_tpu.sharded")
 
@@ -318,6 +319,12 @@ class ShardedEngine:
         self._pallas_sweep_fn = None
         self._grow_fns: dict = {}  # cap_new → compiled grow program
         self.dropped_rows = 0  # rows lost to grow/restore re-placement
+        #: reusable packed-upload matrices, one ring per wave width
+        #: (core/batch.py): leased in _fill_packed, released right
+        #: after the launch consumes them (jax copies host operands at
+        #: dispatch).  V1Instance binds its Metrics here for the
+        #: hit/miss/leak counters.
+        self.wave_pool = WaveBufferPool()
 
     def _init_table_and_step(self) -> None:
         """Build self.state + self._step (subclass hook: the Pallas
@@ -357,7 +364,8 @@ class ShardedEngine:
         else:
             from ..core.table import occupancy, sweep_expired
 
-            self.state = sweep_expired(self.state, np.int64(now_ms))
+            with XLA_EXEC_MU:
+                self.state = sweep_expired(self.state, np.int64(now_ms))
             if self.auto_grow_limit:
                 self.live_rows = int(occupancy(self.state))
         self.sweep_count += 1
@@ -394,8 +402,24 @@ class ShardedEngine:
             self._pallas_sweep_fn = jax.jit(shard_map(
                 _one, mesh=self.mesh, in_specs=(P(SHARD_AXIS), P()),
                 out_specs=(P(SHARD_AXIS), P()), check_vma=False))
-        return self._pallas_sweep_fn(self.state, jnp.asarray(now_ms,
-                                                             jnp.int64))
+        with XLA_EXEC_MU:
+            return self._pallas_sweep_fn(self.state,
+                                         jnp.asarray(now_ms, jnp.int64))
+
+    @staticmethod
+    def _arrival_order(batch: RequestBatch) -> np.ndarray:
+        """Request indices in arrival-time order (earliest requests
+        take the earliest waves, so same-key requests split across
+        waves apply in time order).  The common serving shape — a wave
+        whose ``now`` column is already non-decreasing (one caller, or
+        dispatcher-merged jobs queued in clock order) — skips the
+        argsort: an O(n) monotonicity check replaces the O(n log n)
+        sort on the per-wave host path."""
+        now_col = np.asarray(batch.now)
+        n = len(now_col)
+        if n <= 1 or (now_col[1:] >= now_col[:-1]).all():
+            return np.arange(n, dtype=np.int64)
+        return np.argsort(now_col, kind="stable")
 
     def _build_waves(self, khash: np.ndarray, pending: np.ndarray):
         """Route ``pending`` request indices into device waves.
@@ -426,23 +450,25 @@ class ShardedEngine:
         return waves
 
     def _fill_packed(self, batch: RequestBatch, idx, slots, bw_w):
-        """Scatter a wave's requests straight into the packed wire
-        matrices (one [8, n·Bw] i64 + one [3, n·Bw] i32): fuses the old
-        glob-fill + pack_wave_host into a single set of writes.  At a
-        fast device step (TPU: ~0.2 ms) the host-side copies ARE the
-        serving ceiling, so every column is written exactly once.
+        """Scatter a wave's requests straight into a LEASED pair of
+        packed wire matrices (one [8, n·Bw] i64 + one [3, n·Bw] i32
+        from ``wave_pool``): fuses the old glob-fill + pack_wave_host
+        into a single set of writes, without the per-wave allocation
+        the old path paid (at a fast device step — TPU: ~0.2 ms — the
+        host-side copies and allocator churn ARE the serving ceiling).
+        Returns (a64, a32, lease); the caller must ``lease.release()``
+        once the launch has consumed the buffers, on every path.
         Padding rows keep empty_batch semantics: zeros everywhere,
         eff_ms 1, valid false."""
-        m = self.n * bw_w
-        a64 = np.zeros((len(PACK64), m), np.int64)
-        a32 = np.zeros((len(PACK32), m), np.int32)
+        lease = self.wave_pool.lease(self.n * bw_w)
+        a64, a32 = lease.a64, lease.a32
         a64[PACK64.index("eff_ms")] = 1
         a64[0][slots] = np.asarray(batch.key).view(np.int64)[idx]
         for i, f in enumerate(PACK64[1:], start=1):
             a64[i][slots] = np.asarray(getattr(batch, f))[idx]
         for i, f in enumerate(PACK32):
             a32[i][slots] = np.asarray(getattr(batch, f))[idx]
-        return a64, a32
+        return a64, a32, lease
 
     def launch_packed(self, batch: RequestBatch, khash: np.ndarray,
                       now_ms: int):
@@ -452,12 +478,14 @@ class ShardedEngine:
         Returns an opaque token for ``sync_packed``.  State threads
         through the launches, so later launches are ordered after these
         device-side regardless of when anyone syncs."""
-        now_col = np.asarray(batch.now)
-        pending = np.argsort(now_col, kind="stable")
+        pending = self._arrival_order(batch)
         launched = []
         for idx, slots, bw_w in self._build_waves(khash, pending):
-            a64, a32 = self._fill_packed(batch, idx, slots, bw_w)
-            packed, counters = self._launch_arrays(a64, a32, now_ms)
+            a64, a32, lease = self._fill_packed(batch, idx, slots, bw_w)
+            try:
+                packed, counters = self._launch_arrays(a64, a32, now_ms)
+            finally:
+                lease.release()  # the launch copied the host operands
             launched.append((idx, slots, packed, counters))
         return (batch, khash, now_ms, launched)
 
@@ -525,11 +553,15 @@ class ShardedEngine:
         placement that is identical anyway.  Multi-shard meshes keep
         the explicit sharded put — there it is what makes each device
         receive 1/n of the bytes instead of a full replica."""
-        if self.n > 1:
-            a64 = jax.device_put(a64, self._mat_sharding)
-            a32 = jax.device_put(a32, self._mat_sharding)
-        self.state, packed, counters = self._step(
-            self.state, a64, a32, np.int64(now_ms))
+        with XLA_EXEC_MU:
+            # process-wide execute gate (mesh.py): cross-ENGINE
+            # concurrent executions wedge this image's XLA:CPU; the
+            # per-instance engine lock can't see other instances
+            if self.n > 1:
+                a64 = jax.device_put(a64, self._mat_sharding)
+                a32 = jax.device_put(a32, self._mat_sharding)
+            self.state, packed, counters = self._step(
+                self.state, a64, a32, np.int64(now_ms))
         return packed, counters
 
     def _launch_wave(self, glob: RequestBatch, now_ms: int):
@@ -582,18 +614,22 @@ class ShardedEngine:
         rst_o = np.zeros(n, np.int64)
         lim_o = np.zeros(n, np.int64)
         full = np.zeros(n, bool)
-        now_col = np.asarray(batch.now)
         # earliest requests take the earliest waves: same-key requests
         # split across waves then apply in arrival-time order (within a
         # wave the device's (row, now) sort handles it)
-        pending = np.argsort(now_col, kind="stable")
+        pending = self._arrival_order(batch)
         retried = False
         while len(pending):
             err_idx: List[int] = []
             for idx, slots, bw_w in self._build_waves(khash, pending):
-                a64, a32 = self._fill_packed(batch, idx, slots, bw_w)
+                a64, a32, lease = self._fill_packed(batch, idx, slots,
+                                                    bw_w)
+                try:
+                    launched = self._launch_arrays(a64, a32, now_ms)
+                finally:
+                    lease.release()  # launch copied the host operands
                 o_st, o_rem, o_rst, o_lim, o_err = self._finish_wave(
-                    *self._launch_arrays(a64, a32, now_ms))
+                    *launched)
                 status[idx] = o_st[slots]
                 rem_o[idx] = o_rem[slots]
                 rst_o[idx] = o_rst[slots]
@@ -649,7 +685,8 @@ class ShardedEngine:
         if fn is None:
             fn = make_grow(self.mesh, new_cap_per_shard)
             self._grow_fns[new_cap_per_shard] = fn
-        self.state, dropped = fn(self.state)
+        with XLA_EXEC_MU:
+            self.state, dropped = fn(self.state)
         self.cap_local = new_cap_per_shard
         self.dropped_rows += int(dropped)
         return int(dropped)
@@ -686,8 +723,10 @@ class ShardedEngine:
         for wave, slots in self._route_waves(khash):
             keys = np.zeros(self.n * self.B, np.uint64)
             keys[slots] = khash[wave]
-            f, cols = self._gather(
-                self.state, jax.device_put(keys, self._batch_sharding))
+            with XLA_EXEC_MU:
+                f, cols = self._gather(
+                    self.state,
+                    jax.device_put(keys, self._batch_sharding))
             f = np.asarray(f)
             found[wave] = f[slots]
             for name, col in zip(VALUE_COLS, cols):
@@ -709,9 +748,11 @@ class ShardedEngine:
                 blk = np.zeros(self.n * self.B, dt)
                 blk[slots] = cols[f][wave]
                 block_cols.append(jax.device_put(blk, self._batch_sharding))
-            self.state, placed = self._upsert(
-                self.state, jax.device_put(keys, self._batch_sharding),
-                tuple(block_cols))
+            with XLA_EXEC_MU:
+                self.state, placed = self._upsert(
+                    self.state,
+                    jax.device_put(keys, self._batch_sharding),
+                    tuple(block_cols))
             placed_total += int(np.asarray(placed)[slots].sum())
         return placed_total
 
@@ -724,8 +765,10 @@ class ShardedEngine:
         for wave, slots in self._route_waves(khash):
             keys = np.zeros(self.n * self.B, np.uint64)
             keys[slots] = khash[wave]
-            self.state, found = self._remove(
-                self.state, jax.device_put(keys, self._batch_sharding))
+            with XLA_EXEC_MU:
+                self.state, found = self._remove(
+                    self.state,
+                    jax.device_put(keys, self._batch_sharding))
             removed += int(np.asarray(found)[slots].sum())
         return removed
 

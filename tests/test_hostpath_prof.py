@@ -1,0 +1,32 @@
+"""tools/hostpath_prof.py smoke (tier-1, ISSUE 2 satellite): the
+reproducible §4.2 host-glue profiler runs end-to-end and reports all
+four buckets, so a perf round can always regenerate the breakdown."""
+import json
+import os
+import sys
+
+import pytest
+
+pytest.importorskip("gubernator_tpu.ops.native")
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+def test_hostpath_prof_reports_all_buckets(capsys):
+    import hostpath_prof
+
+    rc = hostpath_prof.main(["--reqs", "64", "--reps", "3",
+                             "--cache-size", "4096"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    buckets = out["buckets_ms_per_call"]
+    # §4.2's four decomposition buckets, all present
+    for b in ("device_step", "parse_pack", "dispatch_future",
+              "response_build"):
+        assert b in buckets, buckets
+    assert out["total_ms_per_call"] > 0
+    assert out["host_glue_ms_per_call"] >= 0
+    assert out["reps"] == 3
+    # the instrumented run actually exercised the serving path
+    assert out["buffer_pool"]["hits"] + out["buffer_pool"]["misses"] > 0

@@ -99,6 +99,15 @@ def test_soak_mixed_traffic_with_churn():
         # a time; churn may re-home it (reset or handover), so admitted
         # lies in [LIMIT, 2×LIMIT] — never more than one extra bucket.
         assert LIMIT <= admitted["strict"] <= 2 * LIMIT, admitted
+        # ISSUE 2: wave buffer-pool leases must come back on EVERY
+        # path (engine raise, timeout, close) — zero tolerance, a leak
+        # regrows the per-wave allocations the pool exists to remove
+        for i in range(2):
+            pool = getattr(cluster.instance_at(i).engine, "wave_pool",
+                           None)
+            if pool is not None:
+                s = pool.stats()
+                assert s["leaks"] == 0 and s["outstanding"] == 0, s
     finally:
         cluster.stop()
 
@@ -176,5 +185,14 @@ def test_soak_pallas_serving_mode_with_churn(monkeypatch):
         # capacity 60; churn may re-home the key once (reset or
         # handover) so admitted lies in [LIMIT, 2*LIMIT]
         assert LIMIT <= admitted["strict"] <= 2 * LIMIT, admitted
+        # ISSUE 2: wave buffer-pool leases must come back on EVERY
+        # path (engine raise, timeout, close) — zero tolerance, a leak
+        # regrows the per-wave allocations the pool exists to remove
+        for i in range(2):
+            pool = getattr(cluster.instance_at(i).engine, "wave_pool",
+                           None)
+            if pool is not None:
+                s = pool.stats()
+                assert s["leaks"] == 0 and s["outstanding"] == 0, s
     finally:
         cluster.stop()

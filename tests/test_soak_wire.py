@@ -124,5 +124,14 @@ def test_wire_soak_with_daemon_restart():
             cluster.instance_at(i).metrics.wire_lane_counter.labels(
                 lane="peer_wire")._value.get() for i in range(3))
         assert peer_wire > 0, "no owner served forwarded columns"
+        # ISSUE 2: buffer-pool leases returned on every path — the
+        # churn window exercises the error paths (peer-forward
+        # failures, daemon restart mid-wave)
+        for i in (0, 2):
+            pool = getattr(cluster.instance_at(i).engine, "wave_pool",
+                           None)
+            if pool is not None:
+                s = pool.stats()
+                assert s["leaks"] == 0 and s["outstanding"] == 0, s
     finally:
         cluster.stop()

@@ -164,7 +164,10 @@ def main() -> int:
         ok, out = run_stage(
             "bench", [sys.executable, os.path.join(_REPO, "bench.py")],
             timeout=7800,
-            env_extra={"GUBER_BENCH_PARTIAL": partial})
+            # device-side serving children must outwait a cold wave
+            # compile (250-305 s) — VERDICT r5 item 6 / r5b stage 4
+            env_extra={"GUBER_BENCH_PARTIAL": partial,
+                       "GUBER_RESULT_TIMEOUT_S": "900"})
         lines = [ln for ln in out.strip().splitlines()
                  if ln.startswith("{")]
         if ok and lines:

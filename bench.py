@@ -771,11 +771,30 @@ def _sec_cfg4():
     return {"4_global_sharded": row}
 
 
+def _section_checkpoint(rows: dict) -> None:
+    """Per-lane checkpoint (ADVICE r5): sections with several
+    independent device waits (svc has three-plus lanes, each able to
+    eat a full 900 s GUBER_RESULT_TIMEOUT_S wait) write finished lanes
+    to the section-out path as they land, so a subprocess killed at the
+    section budget keeps every lane measured before the kill —
+    _run_section salvages the file on TimeoutExpired."""
+    path = os.environ.get("GUBER_BENCH_SECTION_OUT")
+    if not path:
+        return
+    try:
+        with open(path + ".tmp", "w") as f:
+            json.dump(rows, f)
+        os.replace(path + ".tmp", path)
+    except OSError as e:  # pragma: no cover - diagnostics only
+        log(f"section checkpoint write failed: {e}")
+
+
 def _sec_svc():
     """Service path: full V1Instance routing + dispatcher + response
     assembly (benchmark_test.go › BenchmarkServer_GetRateLimit analog),
     its C++ wire lane, the 16-thread concurrent front door, and the
-    peer-forwarding apply path (BenchmarkServer_GetPeerRateLimit)."""
+    peer-forwarding apply path (BenchmarkServer_GetPeerRateLimit).
+    Each lane checkpoints as it finishes (_section_checkpoint)."""
     from gubernator_tpu.config import Config
     from gubernator_tpu.instance import V1Instance
     from gubernator_tpu.parallel import make_mesh
@@ -794,6 +813,7 @@ def _sec_svc():
         dps_svc = reps * 1000 / (time.perf_counter() - t0)
         out["6_service_path"] = {"decisions_per_s": round(dps_svc),
                                  "batch": 1000}
+        _section_checkpoint(out)
         # the C++ wire lane (bytes → columns → device → bytes), the
         # path a gRPC client actually exercises
         try:
@@ -821,6 +841,7 @@ def _sec_svc():
                 float(np.percentile(lat, 99)), 3)
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["wire_lane_error"] = (str(e) or repr(e))[:200]
+        _section_checkpoint(out)
         # concurrent front door: 16 caller threads through the full
         # wire lane — the dispatcher coalesces them into shared waves
         try:
@@ -857,6 +878,7 @@ def _sec_svc():
                 "host class — PERF.md §8")
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["concurrent_error"] = (str(e) or repr(e))[:200]
+        _section_checkpoint(out)
         # host-glue decomposition (tools/hostpath_prof.py): the §4.2
         # buckets measured live on this instance — a perf round reads
         # parse/pack vs dispatcher/future vs build straight from the
@@ -869,8 +891,13 @@ def _sec_svc():
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["host_glue_error"] = (
                 str(e) or repr(e))[:200]
+        _section_checkpoint(out)
         # peer-forwarding path: what the owner-side apply of a
-        # forwarded batch takes, via its wire lane
+        # forwarded batch takes, via its wire lane (since ISSUE 3 the
+        # fused C++ ingest: received TLV bytes → leased packed wave →
+        # device → response bytes).  Same harness shape as the pre-PR
+        # rounds (sequential 1000-req applies), so the pre/post ratio
+        # is like-for-like.
         try:
             from gubernator_tpu.proto import peers_pb2 as peers_pb
             from gubernator_tpu.wire import req_to_pb
@@ -888,7 +915,17 @@ def _sec_svc():
             out["8_peer_path"] = {
                 "decisions_per_s": round(
                     reps * 1000 / (time.perf_counter() - t0)),
-                "batch": 1000}
+                "batch": 1000,
+                # ISSUE 3 acceptance record: the same loop measured on
+                # this host at the pre-PR tree (HEAD^ worktree, median
+                # of 3 runs), so the columnar-ingest speedup audits
+                # from this JSON alone
+                "pre_pr_decisions_per_s": 342870,
+                "pre_pr_context": (
+                    "pre-PR baseline measured 2026-08-04 on this "
+                    "1-core build host (CPU backend, median of 3 "
+                    "same-harness runs; run-to-run spread ~±15% on "
+                    "this shared host) — PERF.md §9")}
         except Exception as e:  # noqa: BLE001
             out["8_peer_path"] = {"error": (str(e) or repr(e))[:200]}
         if "6_service_path" in out:
@@ -924,8 +961,32 @@ def _sec_cluster():
         dps_c3 = reps * 1000 / (time.perf_counter() - t0)
         lane = inst0.metrics.wire_lane_counter.labels(
             lane="wire_clustered")._value.get()
+        # conservation (ISSUE 3 acceptance): one shared key drained
+        # through ALL THREE daemons must debit exactly once per hit —
+        # ring ownership + the pooled forward lanes must not lose,
+        # duplicate, or misroute a request
+        conserved = None
+        try:
+            from gubernator_tpu.proto import gubernator_pb2 as _pb
+
+            def _one(hits):
+                m = _pb.GetRateLimitsReq()
+                rq = m.requests.add()
+                rq.name, rq.unique_key = "c3cons", "shared"
+                rq.hits, rq.limit, rq.duration = hits, 10**6, 600_000
+                return m.SerializeToString()
+
+            for d in range(3):
+                c3.instance_at(d).get_rate_limits_wire(
+                    _one(5), now_ms=NOW0 + 400 + d)
+            q = _pb.GetRateLimitsResp.FromString(
+                inst0.get_rate_limits_wire(_one(0), now_ms=NOW0 + 410))
+            conserved = int(q.responses[0].remaining) == 10**6 - 15
+        except Exception as e:  # noqa: BLE001
+            conserved = f"check failed: {(str(e) or repr(e))[:120]}"
         row = {"decisions_per_s": round(dps_c3), "daemons": 3,
                "wire_clustered_requests": int(lane),
+               "conservation_exact": conserved,
                "telemetry": _telemetry_rows(inst0)}
         cores = _host_cores()
         if cores < 3:
@@ -988,22 +1049,43 @@ def _group_contention_probe(n_procs: int, reps_g: int) -> dict:
             th.join()
         wall = time.perf_counter() - t0
         flat = [x for ls in lat for x in ls]
+        # spread check (VERDICT r5 #4): per-address scrape failures are
+        # RECORDED (never `except: pass`), the expected lane labels
+        # must actually exist in the exposition, and a check that
+        # couldn't run reports `spread_check_failed` instead of a `0`
+        # that contradicts the completed-calls count
         spread = 0
+        spread_errors = []
+        # a daemon that served ANY request shows one of these lanes
+        lane_labels = ('lane="wire_local"', 'lane="wire_clustered"',
+                       'lane="peer_wire"', 'lane="pb2_fallback"')
         for addr in grp.http_addresses:
             try:
                 with urllib.request.urlopen(
                         f"http://{addr}/metrics", timeout=10) as f:
                     text = f.read().decode()
+                lane_lines = [
+                    line for line in text.splitlines()
+                    if line.startswith(
+                        "gubernator_wire_lane_requests_total")]
+                if not any(lb in line for line in lane_lines
+                           for lb in lane_labels):
+                    # served traffic MUST label a lane; a scrape with
+                    # none means the metric surface changed under us —
+                    # flag it rather than counting a silent 0
+                    spread_errors.append(
+                        f"{addr}: no wire-lane labels in exposition "
+                        f"({len(lane_lines)} lane lines)")
+                    continue
                 got = any(
                     line.split()[-1] not in ("0", "0.0")
-                    for line in text.splitlines()
-                    if line.startswith(
-                        "gubernator_wire_lane_requests_total")
-                    and ('lane="wire_local"' in line
-                         or 'lane="wire_clustered"' in line))
+                    for line in lane_lines
+                    if ('lane="wire_local"' in line
+                        or 'lane="wire_clustered"' in line))
                 spread += bool(got)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                spread_errors.append(
+                    f"{addr}: scrape failed: {(str(e) or repr(e))[:120]}")
         # conservation: one key drained through every connection (the
         # kernel spreads them over processes) must debit exactly once
         # per hit — ring ownership, not per-process buckets
@@ -1031,8 +1113,17 @@ def _group_contention_probe(n_procs: int, reps_g: int) -> dict:
             "contention_completed_calls": len(flat),
             "contention_expected_calls": n_chan * reps_g,
             "conservation_exact": conserved,
-            "processes_seeing_traffic": spread,
+            # a spread count the scrapes couldn't establish must say
+            # so — a silent 0 next to N completed calls is a
+            # contradiction, not a measurement (VERDICT r5 #4).  With
+            # partial scrape failures a non-zero count still stands as
+            # a lower bound (errors recorded beside it).
+            "processes_seeing_traffic": (
+                "spread_check_failed"
+                if spread_errors and spread == 0 else spread),
             "processes": n_procs}
+        if spread_errors:
+            row["spread_check_errors"] = spread_errors[:4]
         if flat:
             row["contention_p99_ms"] = round(
                 float(np.percentile(flat, 99)), 3)
@@ -1391,16 +1482,20 @@ def _run_section(name, inline):
         env["GUBER_BENCH_EXPECT_BACKEND"] = _EXPECT_BACKEND
     # worst observed tunnel compile is ~305 s; budgets give margin per
     # cold compile a section legitimately needs (svc compiles BOTH
-    # wave buckets; cluster/cfg5 one fresh shape each) PLUS one full
-    # 900 s dispatcher wave-wait (GUBER_RESULT_TIMEOUT_S above): a
-    # wedged wave must surface as that caller's TimeoutError row, not
-    # as this subprocess timeout killing the section's already-written
-    # lanes before _section_main's atomic write.  One such section +
-    # the follow-up probe still fits the watchdog's whole-run deadline
-    # (see _watchdog_main).  pallas: a cold Mosaic kernel compile
-    # (~220-305 s over the tunnel) + the fused occ/sat program + a
-    # 2 GiB table init + the same wave-wait.
-    budgets = {"svc": 2400, "cluster": 2100, "cfg5": 1200,
+    # wave buckets; cluster/cfg5 one fresh shape each) PLUS dispatcher
+    # wave-waits (GUBER_RESULT_TIMEOUT_S above): a wedged wave must
+    # surface as that caller's TimeoutError row, not as this
+    # subprocess timeout killing the section's already-written lanes.
+    # svc is budgeted for THREE independent 900 s waits (its object,
+    # wire, and concurrent lanes each submit fresh waves — ADVICE r5)
+    # plus its two cold bucket compiles; even when the budget still
+    # trips, the per-lane checkpoints (_section_checkpoint) keep every
+    # finished lane — the TimeoutExpired path below salvages them.
+    # One wedged section + the follow-up probe still fits the
+    # watchdog's whole-run deadline (see _watchdog_main).  pallas: a
+    # cold Mosaic kernel compile (~220-305 s over the tunnel) + the
+    # fused occ/sat program + a 2 GiB table init + one wave-wait.
+    budgets = {"svc": 3600, "cluster": 2100, "cfg5": 1200,
                "pallas": 2400}
     timeout = int(os.environ.get("GUBER_BENCH_SECTION_TIMEOUT",
                                  str(budgets.get(name, 900))))
@@ -1417,8 +1512,21 @@ def _run_section(name, inline):
         log(f"[{name}] section timed out after {timeout}s — probing link")
         if not _device_probe():
             _WEDGED = True
-        return {"error": f"section timed out after {timeout}s "
-                         "(wedged device compile?)"}
+        err = (f"section timed out after {timeout}s "
+               "(wedged device compile?)")
+        # salvage the per-lane checkpoints the killed child already
+        # wrote (_section_checkpoint): finished lanes survive the kill
+        try:
+            with open(path) as f:
+                rows = json.load(f)
+            if rows:
+                rows["partial"] = err
+                log(f"[{name}] salvaged {len(rows) - 1} checkpointed "
+                    "row(s) from the killed section")
+                return rows
+        except (OSError, ValueError):
+            pass
+        return {"error": err}
     except Exception as e:  # noqa: BLE001
         return {"error": f"{name}: {(str(e) or repr(e))[:300]}"}
     finally:

@@ -82,6 +82,25 @@ class BehaviorConfig:
     multi_region_timeout_ms: int = 900
     multi_region_batch_limit: int = 1000
 
+    #: Columnar peer send lanes (peer_client.py › _SendLane): depth-K
+    #: in-flight RPCs per peer per method — the forward hop's analog of
+    #: the dispatcher's overlapped wave pipeline.
+    peer_inflight: int = 4
+    #: Send-buffer coalescing window (µs): how long a flush waits for
+    #: straggler entries after draining the backlog — mirrors the
+    #: dispatcher's GUBER_COALESCE_US rule (greedy backlog first, never
+    #: overshoot the batch limit, tiny straggler window).
+    peer_coalesce_us: int = 200
+    #: Re-send attempts for a failed flush RPC before its requests get
+    #: error responses (each retry backs off linearly).
+    peer_retry_limit: int = 2
+    peer_retry_backoff_ms: int = 25
+    #: Consecutive flush failures (after retries) that OPEN the peer's
+    #: circuit: sends fail fast instead of queuing behind a dead peer
+    #: until the cooldown elapses (then one probe flush half-opens it).
+    peer_circuit_threshold: int = 3
+    peer_circuit_cooldown_ms: int = 2000
+
 
 @dataclass
 class Config:

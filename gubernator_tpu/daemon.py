@@ -290,6 +290,15 @@ class Daemon:
                         # not just liveness (cmd/healthcheck.py --deep)
                         body["dispatcher"] = \
                             daemon.instance.dispatcher.debug_stats()
+                        # per-peer send-lane + circuit state (ISSUE 3):
+                        # a backed-up buffer or an open circuit is the
+                        # forward hop's stall signal
+                        peers_blk = {}
+                        for p in daemon.instance.peers():
+                            if hasattr(p, "lane_stats"):
+                                peers_blk[p.info.grpc_address] = \
+                                    p.lane_stats()
+                        body["peers"] = peers_blk
                     self._send(code, json.dumps(body).encode())
                 elif path == "/debug/events":
                     # flight recorder ring (telemetry.py), newest-last;

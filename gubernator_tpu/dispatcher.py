@@ -200,12 +200,17 @@ class Dispatcher:
                 self.pipeline_depth if self._pipelined else 0)
         env_timeout = os.environ.get("GUBER_RESULT_TIMEOUT_S", "")
         if env_timeout:
+            import math
+
             try:
                 parsed = float(env_timeout)
             except ValueError:
                 parsed = 0.0  # malformed: keep the class default
-            if parsed > 0:  # also rejects 0/negative/NaN — a 0 s wait
-                # would fail EVERY queued wave instantly
+            if math.isfinite(parsed) and parsed > 0:
+                # rejects 0/negative/NaN (a 0 s wait would fail EVERY
+                # queued wave instantly) AND 'inf' (which silently
+                # disabled the wave-wait cap: a wedged wave would park
+                # its caller forever with no timeout diagnosis)
                 self.RESULT_TIMEOUT_S = parsed
         # Stall watchdog: default well below the result timeout (and
         # scaled down with it, so a tightened timeout keeps the "stall
@@ -283,6 +288,33 @@ class Dispatcher:
             self._inline_mu.release()
             return False
         return True
+
+    def run_inline_wave(self, kind: str, nreq: int, fn):
+        """Run ``fn()`` (an engine call the caller composed — the fused
+        wire lane, instance.py › _wire_check_fused) as ONE inline wave
+        in the calling thread, with the same engine-lock discipline and
+        wave telemetry as check_batch's idle fast path.  Returns
+        ``fn()``'s result, or the _BUSY sentinel when the idle inline
+        path isn't available (queued jobs / pipelining / closing) — the
+        caller then falls back to the classic submit path."""
+        if not self._try_inline():
+            return self._BUSY
+        try:
+            wid = self._wave_begin(kind, nreq=nreq)
+            try:
+                with self._engine_lock:
+                    out = fn()
+            except Exception as e:  # noqa: BLE001 - recorded, re-raised
+                self._wave_end(wid, error=e)
+                raise
+            self._wave_end(wid)
+            return out
+        finally:
+            self._inline_mu.release()
+
+    #: run_inline_wave's "dispatcher busy" sentinel (None is a valid
+    #: engine-call result, so the miss needs its own identity)
+    _BUSY = object()
 
     def check_batch(self, reqs: Sequence[RateLimitRequest], now_ms: int
                     ) -> List[RateLimitResponse]:

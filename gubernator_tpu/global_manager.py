@@ -28,6 +28,24 @@ from .types import Behavior, RateLimitRequest
 log = logging.getLogger("gubernator_tpu.global")
 
 
+def _raw_lanes_available() -> bool:
+    """The columnar flush paths need the native codec (peer_client's
+    send lanes split responses with it)."""
+    try:
+        from .ops import native  # noqa: F401
+        return True
+    except ImportError:  # pragma: no cover - unbuilt extension
+        return False
+
+
+def _failed_future(e: BaseException):
+    from concurrent.futures import Future
+
+    f: Future = Future()
+    f.set_exception(e)
+    return f
+
+
 class GlobalManager:
     def __init__(self, instance, behaviors: BehaviorConfig, metrics):
         self.instance = instance
@@ -138,11 +156,25 @@ class GlobalManager:
 
     def _run_async_hits(self) -> None:
         """Flush aggregated hits to each key's owner.
-        reference: global.go › runAsyncHits."""
+        reference: global.go › runAsyncHits.
+
+        Columnar path (default-hash pickers + native codec): BOTH
+        lanes' queues merge in raw-khash space, each key's aggregate
+        becomes one TLV with the summed hits appended
+        (wire.tlv_with_hits — zero request materialization), and the
+        per-owner payloads ride the peers' pooled forward lanes
+        (pipelined flushes, retry, circuit fail-fast), aggregated per
+        peer per window.  Non-default pickers / no codec keep the
+        legacy object flush."""
         with self._mu:
             hits, self._hits = self._hits, {}
             hits_raw, self._hits_raw = self._hits_raw, {}
         self.metrics.queue_length.set(0)
+        inst = self.instance
+        if ((hits_raw or hits) and _raw_lanes_available()
+                and inst.default_hash_routing()):
+            self._flush_hits_raw(hits, hits_raw)
+            return
         for khash, (tlv, acc, seq) in hits_raw.items():
             try:
                 req = self._req_from_tlv(tlv)
@@ -191,6 +223,66 @@ class GlobalManager:
                                    error=errors[-1])
         self._record(errors)
 
+    def _flush_hits_raw(self, hits, hits_raw) -> None:
+        """Columnar hit flush: raw-khash merge → per-key TLV with the
+        aggregate hits → per-owner payloads on the forward lanes."""
+        from .hashing import fnv1a64
+        from .wire import req_to_tlv, tlv_with_hits
+
+        merged: Dict[int, Tuple[object, int, int]] = dict(hits_raw)
+        for key, (req, acc, seq) in hits.items():
+            kh = fnv1a64(key.encode("utf-8"))
+            cur = merged.get(kh)
+            if cur is None:
+                merged[kh] = (req, acc, seq)
+            else:
+                proto, a0, s0 = cur
+                merged[kh] = (req if seq >= s0 else proto, a0 + acc,
+                              max(s0, seq))
+        inst = self.instance
+        by_owner: Dict[str, Tuple[object, List[bytes]]] = {}
+        for kh, (proto, acc, _seq) in merged.items():
+            if acc <= 0:
+                continue
+            peer = inst.owner_by_raw_khash(kh)
+            if peer is None or inst.is_self(peer):
+                continue  # we are the owner: already applied locally
+            tlv = (tlv_with_hits(proto, acc) if isinstance(proto, bytes)
+                   else req_to_tlv(RateLimitRequest(
+                       name=proto.name, unique_key=proto.unique_key,
+                       hits=acc, limit=proto.limit,
+                       duration=proto.duration,
+                       algorithm=proto.algorithm, behavior=proto.behavior,
+                       burst=proto.burst)))
+            addr = peer.info.grpc_address
+            by_owner.setdefault(addr, (peer, []))[1].append(tlv)
+        futs = []
+        limit = self.behaviors.global_batch_limit
+        for addr, (peer, tlvs) in by_owner.items():
+            for i in range(0, len(tlvs), limit):
+                chunk = tlvs[i:i + limit]
+                try:
+                    futs.append((addr, peer.forward_raw(
+                        b"".join(chunk), len(chunk))))
+                except Exception as e:  # noqa: BLE001 - ErrCircuitOpen/
+                    # ErrClosing fail fast; next tick retries fresh
+                    futs.append((addr, _failed_future(e)))
+        errors = []
+        deadline = time.monotonic() + \
+            self.behaviors.global_timeout_ms / 1000.0 + 30.0
+        for addr, fut in futs:
+            try:
+                fut.result(timeout=max(deadline - time.monotonic(), 0.1))
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"global hits sync to {addr}: "
+                              f"{exc_text(e)}")
+                self.metrics.check_error_counter.labels(
+                    error="global_hits_sync").inc()
+                log.warning(errors[-1])
+                self._record_event("error", stage="global_hits_sync",
+                                   error=errors[-1])
+        self._record(errors)
+
     def _run_broadcasts(self) -> None:
         """Owner side: push merged authoritative state to all peers.
         reference: global.go › runBroadcasts → UpdatePeerGlobals."""
@@ -216,17 +308,58 @@ class GlobalManager:
             return
         peers = [p for p in self.instance.peers() if not self.instance.is_self(p)]
         errors = []
-        for peer in peers:
-            try:
-                limit = self.behaviors.global_batch_limit
-                for i in range(0, len(msgs), limit):
-                    peer.update_peer_globals(msgs[i:i + limit])
-            except Exception as e:  # noqa: BLE001
-                errors.append(f"global broadcast to "
-                              f"{peer.info.grpc_address}: {exc_text(e)}")
-                self.metrics.check_error_counter.labels(
-                    error="global_broadcast").inc()
-                log.warning(errors[-1])
+        limit = self.behaviors.global_batch_limit
+        if peers and _raw_lanes_available():
+            # columnar broadcast: serialize each UpdatePeerGlobal ONCE
+            # into its `globals` TLV (the typed stub re-serialized the
+            # same messages per peer), then every peer's chunk rides
+            # its pooled update lane — pipelined, retried, circuit-
+            # gated, aggregated per peer per window
+            from .wire import _varint
+
+            tlvs = []
+            for m in msgs:
+                payload = m.SerializeToString()
+                tlvs.append(b"\x0a" + _varint(len(payload)) + payload)
+            chunks = [b"".join(tlvs[i:i + limit])
+                      for i in range(0, len(tlvs), limit)]
+            futs = []
+            for peer in peers:
+                for i, chunk in enumerate(chunks):
+                    n = min(limit, len(tlvs) - i * limit)
+                    try:
+                        futs.append((peer.info.grpc_address,
+                                     peer.send_globals_raw(chunk, n)))
+                    except Exception as e:  # noqa: BLE001 - fail fast
+                        futs.append((peer.info.grpc_address,
+                                     _failed_future(e)))
+            deadline = time.monotonic() + \
+                self.behaviors.global_timeout_ms / 1000.0 + 30.0
+            failed_addrs = set()
+            for addr, fut in futs:
+                try:
+                    fut.result(timeout=max(deadline - time.monotonic(),
+                                           0.1))
+                except Exception as e:  # noqa: BLE001
+                    if addr not in failed_addrs:
+                        failed_addrs.add(addr)
+                        errors.append(f"global broadcast to {addr}: "
+                                      f"{exc_text(e)}")
+                        self.metrics.check_error_counter.labels(
+                            error="global_broadcast").inc()
+                        log.warning(errors[-1])
+        else:
+            for peer in peers:
+                try:
+                    for i in range(0, len(msgs), limit):
+                        peer.update_peer_globals(msgs[i:i + limit])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"global broadcast to "
+                                  f"{peer.info.grpc_address}: "
+                                  f"{exc_text(e)}")
+                    self.metrics.check_error_counter.labels(
+                        error="global_broadcast").inc()
+                    log.warning(errors[-1])
         self._record(errors)
         self.metrics.global_broadcast_counter.inc()
         self.metrics.broadcast_duration.observe(time.perf_counter() - t0)

@@ -239,6 +239,15 @@ class PeersV1Stub:
             response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString)
 
 
+def raw_unary(channel: grpc.Channel, method: str):
+    """bytes-in/bytes-out unary call handle on the peers service
+    (identity serializers).  The columnar send lanes (peer_client.py ›
+    _SendLane) ship concatenated TLV slices through these — wire format
+    is identical to the typed stubs, just with zero pb2 objects on this
+    side."""
+    return channel.unary_unary(f"/{PEERS_SERVICE}/{method}")
+
+
 def dial_peer(address: str, tls_creds: Optional[grpc.ChannelCredentials] = None
               ) -> grpc.Channel:
     """Open a channel to a peer (peer_client.go › dialPeer analog)."""

@@ -358,6 +358,22 @@ class V1Instance:
                 return None
             return self._picker.get(key)
 
+    def default_hash_routing(self) -> bool:
+        """True when the picker runs the default mixed_fnv1a64 pipeline,
+        i.e. raw-khash owner lookups (owner_by_raw_khash) are valid."""
+        with self._peer_mu:
+            picker = self._picker
+        return self._uses_default_hash(picker)
+
+    def owner_by_raw_khash(self, khash_raw: int) -> Optional[PeerClient]:
+        """Owner peer for a RAW (unmixed) FNV-1a64 key hash — the wire
+        lanes' async-queue key space.  Callers gate on
+        ``default_hash_routing()`` first."""
+        with self._peer_mu:
+            if not self._picker.peers():
+                return None
+            return self._picker.get_by_raw_hash(khash_raw)
+
     def is_self(self, peer: PeerClient) -> bool:
         return peer.info.grpc_address == self._self_addr
 
@@ -431,6 +447,17 @@ class V1Instance:
         is_global = False
         clustered = False
         if _wire_native is not None and self.store is None:
+            peer_list = self.peers()
+            if not peer_list or all(self.is_self(p) for p in peer_list):
+                # solo fused lane: bytes → leased packed wave → device
+                # → bytes in one C++ ingest pass (no parse/pack numpy
+                # columns at all); returns None for anything it can't
+                # model (GLOBAL/MR rows, Gregorian, pb2 framing,
+                # busy-path gates) and the classic lanes below take
+                # over with identical semantics
+                out = self._wire_client_fused(data, now_ms)
+                if out is not None:
+                    return out
             parsed = _wire_native.parse_get_rate_limits(data)
             if parsed is not None:
                 is_global = bool(parsed["behavior_or"]
@@ -522,6 +549,126 @@ class V1Instance:
         out.responses.extend(resp_to_pb(r) for r in resps)
         return out.SerializeToString()
 
+    # ---- fused wire lane (ops/_native.cpp › pack_wire_wave) ------------
+
+    #: behaviors whose async side effects (hot-set routing, GLOBAL
+    #: reconcile queues, cross-region replication) need the parsed
+    #: columns — the fused lane hands them to the classic lanes
+    _FUSED_EXCLUDED = Behavior.GLOBAL | Behavior.MULTI_REGION
+
+    def _wire_client_fused(self, data: bytes,
+                           now_ms: Optional[int]) -> Optional[bytes]:
+        """Solo client twin of ``_wire_peer_fused``: the GetRateLimits
+        front door when this daemon owns every key.  Returns None when
+        the fused lane can't serve the batch (caller falls back)."""
+        prepack = getattr(self.engine, "prepack_wire", None)
+        if prepack is None:
+            return None
+        now = clock_ms() if now_ms is None else now_ms
+        pre = prepack(data, now)
+        if pre is None:
+            return None
+        if pre.behavior_or & int(self._FUSED_EXCLUDED):
+            # GLOBAL rides the hot-set flow, MULTI_REGION queues async
+            # replication — both need the parsed columns; the classic
+            # lanes keep those semantics in one place
+            pre.lease.release()
+            return None
+        if pre.n > MAX_BATCH_SIZE:
+            pre.lease.release()
+            raise ValueError(
+                f"Requests.RateLimits list too large; max size is "
+                f"{MAX_BATCH_SIZE}")
+        self.metrics.getratelimit_counter.labels(calltype="api").inc(
+            pre.n)
+        self.metrics.wire_lane_counter.labels(lane="wire_local").inc(
+            pre.n)
+        self.metrics.concurrent_checks.inc()
+        try:
+            with self.metrics.time_func("GetRateLimits"):
+                out = self._run_fused(pre, now)
+                self._maybe_sweep(now)
+                return out
+        finally:
+            self.metrics.concurrent_checks.dec()
+
+    def _wire_peer_fused(self, data: bytes,
+                         now_ms: Optional[int]) -> Optional[bytes]:
+        """Fused owner side of the forward hop: received TLV bytes go
+        straight into a leased packed wave (C++ parse+clamp+hash+fill,
+        zero numpy column passes) and responses serialize from the
+        wave's result columns — a forwarded batch costs the same as a
+        local wire call.  None → classic lane (GLOBAL/MR rows whose
+        async queues need parsed columns, Gregorian, pb2 framing)."""
+        prepack = getattr(self.engine, "prepack_wire", None)
+        if prepack is None:
+            return None
+        now = clock_ms() if now_ms is None else now_ms
+        pre = prepack(data, now)
+        if pre is None:
+            return None
+        if pre.behavior_or & int(self._FUSED_EXCLUDED):
+            pre.lease.release()
+            return None
+        if pre.n > self.config.behaviors.batch_limit:
+            pre.lease.release()
+            raise ValueError(
+                "'PeerRequest.rate_limits' list too large; max size is "
+                f"{self.config.behaviors.batch_limit}")
+        self.metrics.getratelimit_counter.labels(calltype="peer").inc(
+            pre.n)
+        self.metrics.wire_lane_counter.labels(lane="peer_wire").inc(
+            pre.n)
+        return self._run_fused(pre, now)
+
+    def _run_fused(self, pre, now: int) -> bytes:
+        """Execute a prepacked wave and serialize its responses.  Idle:
+        one inline wave in this thread (block order == request order,
+        so results serialize straight from the engine columns).  Busy:
+        the lease's rows rebuild into a RequestBatch and ride the
+        normal coalescing submit path."""
+        disp = self.dispatcher
+        eng = self.engine
+        n = pre.n
+        out = disp.run_inline_wave(
+            "inline_wire", n, lambda: eng.check_prepacked(pre, now))
+        if out is not disp._BUSY:
+            status, lim, rem, rst, full = out
+            self.metrics.over_limit_counter.inc(
+                int((status == 1).sum()))
+            errors = None
+            if full.any():
+                errors = [None] * n
+                for i in np.nonzero(full)[0]:
+                    errors[int(i)] = "rate limit table full"
+            return _wire_native.build_responses_from_columns(
+                (status, lim, rem, rst, full), 0, n, errors)
+        # contended: copy the rows out of the lease (the queued job
+        # outlives it) and coalesce with the other callers' waves
+        from .core.batch import RequestBatch
+
+        a64, a32 = pre.lease.a64, pre.lease.a32
+        batch = RequestBatch(
+            key=a64[0][:n].astype(np.int64).view(np.uint64),
+            hits=a64[1][:n].copy(), limit=a64[2][:n].copy(),
+            duration=a64[3][:n].copy(), eff_ms=a64[4][:n].copy(),
+            greg_end=a64[5][:n].copy(), behavior=a32[0][:n].copy(),
+            algorithm=a32[1][:n].copy(), burst=a64[6][:n].copy(),
+            valid=a32[2][:n] != 0, now=a64[7][:n].copy())
+        kh = pre.khash
+        pre.lease.release()
+        view = disp.check_packed_view(batch, kh, now)
+        status = view.cols[0][view.lo:view.hi]
+        full = view.cols[4][view.lo:view.hi]
+        self.metrics.over_limit_counter.inc(int((status == 1).sum()))
+        errors = None
+        if full.any():
+            errors = [None] * n
+            for i in np.nonzero(full)[0]:
+                errors[int(i)] = "rate limit table full"
+        return _wire_native.build_responses_from_columns(
+            view.cols, view.lo, view.hi, errors)
+
     def get_peer_rate_limits_wire(self, data: bytes,
                                   now_ms: Optional[int] = None) -> bytes:
         """Wire-to-wire GetPeerRateLimits — the owner side of request
@@ -537,6 +684,9 @@ class V1Instance:
         does."""
         parsed = None
         if _wire_native is not None and self.store is None:
+            out = self._wire_peer_fused(data, now_ms)
+            if out is not None:
+                return out
             parsed = _wire_native.parse_get_rate_limits(data)
         if parsed is None:
             from google.protobuf.message import DecodeError
@@ -848,9 +998,14 @@ class V1Instance:
             local_mask = local_mask | glob_mask
         item_tlvs: List[Optional[bytes]] = [None] * n
 
-        # fire remote forwards first so the local device step overlaps.
-        # NB: a grpc call future is itself an RpcError subclass, so
-        # dispatch failures travel in their own slot, never by isinstance
+        # fire remote forwards first so the local device step overlaps:
+        # each owner's sub-batch enters its peer's pooled send buffer
+        # (peer_client.py › forward_raw) — concurrent callers
+        # forwarding to the same owner share flush RPCs, with depth-K
+        # in flight; a dead peer fails fast via ErrCircuitOpen instead
+        # of queuing every caller behind its timeouts.  The TLV slices
+        # join through ONE memoryview (no per-slice bytes copies).
+        mv = memoryview(data)
         groups = []
         for pi in np.unique(owners[~local_mask]):
             # ~local_mask also excludes GLOBAL rows that share an owner
@@ -859,11 +1014,13 @@ class V1Instance:
             # double-debit the owner
             idxs = np.nonzero((owners == pi) & ~local_mask)[0]
             sub = b"".join(
-                data[int(toff[i]):int(toff[i] + tlen[i])] for i in idxs)
+                mv[int(toff[i]):int(toff[i] + tlen[i])] for i in idxs)
             fut = send_err = None
             try:
-                fut = peer_list[int(pi)].get_peer_rate_limits_raw_future(sub)
-            except Exception as e:  # noqa: BLE001 - incl. ErrClosing
+                fut = peer_list[int(pi)].forward_raw(sub, int(idxs.size))
+            except Exception as e:  # noqa: BLE001 - incl. ErrClosing /
+                # ErrCircuitOpen (fail-fast: per-request error rows for
+                # this sub-batch only, the object path's semantics)
                 send_err = e
             groups.append((idxs, fut, send_err))
 
@@ -900,11 +1057,19 @@ class V1Instance:
             if mr_mask.any():
                 self._queue_mr_raw(parsed, data, mr_mask)
 
+        # lane futures always resolve (RPC deadline + bounded retries +
+        # explicit failure paths); the wait bound below is that worst
+        # case plus slack, a belt against a lane bug parking a caller
+        b = self.config.behaviors
+        fwd_wait = ((b.peer_retry_limit + 1)
+                    * (b.batch_timeout_ms / 1000.0 + 60.0)
+                    + b.peer_retry_limit * b.peer_retry_backoff_ms
+                    / 1000.0 + 5.0)
         for idxs, fut, send_err in groups:
             rbytes, err, sp = None, send_err, None
             if fut is not None:
                 try:
-                    rbytes = fut.result()  # deadline set at call time
+                    rbytes = fut.result(timeout=fwd_wait)
                 except Exception as e:  # noqa: BLE001
                     err = e
             if rbytes is not None:

@@ -156,6 +156,41 @@ class Metrics:
             "wave buffer leases dropped without release (reclaimed by "
             "the GC hook; must stay 0 — asserted by the soak tests)",
             registry=r)
+        # Columnar peer send lanes (ISSUE 3): the pooled per-peer send
+        # buffers, depth-K in-flight forward RPCs, and retry/circuit
+        # machinery are the forward hop's moving parts — export their
+        # shape so a backed-up or circuit-open peer is visible on
+        # /metrics, not just as caller error strings.
+        self.peer_send_buffer_depth = Gauge(
+            "gubernator_peer_send_buffer_depth",
+            "request TLVs queued in a peer's send buffer awaiting a "
+            "flush", ["peer_addr"], registry=r)
+        self.peer_flush_size = Histogram(
+            "gubernator_peer_flush_size",
+            "request TLVs per peer flush RPC",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+            registry=r)
+        self.peer_flush_wait = Histogram(
+            "gubernator_peer_flush_wait",
+            "entry wait from send-buffer enqueue to its flush RPC "
+            "launching (s)", buckets=_BUCKETS, registry=r)
+        self.peer_inflight_rpcs = Gauge(
+            "gubernator_peer_inflight_rpcs",
+            "peer flush RPCs currently in flight (depth-K pipelined)",
+            ["peer_addr"], registry=r)
+        self.peer_retry_counter = Counter(
+            "gubernator_peer_retries",
+            "peer flush RPCs re-sent after a failure (backoff applies)",
+            ["peer_addr"], registry=r)
+        self.peer_circuit_open_counter = Counter(
+            "gubernator_peer_circuit_opens",
+            "times a peer's circuit opened (consecutive flush failures "
+            "crossed peer_circuit_threshold)", ["peer_addr"],
+            registry=r)
+        self.peer_circuit_state = Gauge(
+            "gubernator_peer_circuit_state",
+            "1 while a peer's circuit is open (sends fail fast)",
+            ["peer_addr"], registry=r)
 
     @contextmanager
     def time_func(self, name: str):

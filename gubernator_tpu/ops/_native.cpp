@@ -322,6 +322,232 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
   return out;
 }
 
+// count_req_items(bytes) -> n | None
+// Top-level-only scan of a GetRateLimitsReq / GetPeerRateLimitsReq:
+// counts the repeated field-1 TLVs without touching their payloads, so
+// the fused ingest below can size its wave bucket (and lease the packed
+// upload buffers) before the single full parse.  None on any framing
+// the fast lane doesn't model (caller falls back to pb2).
+static PyObject* count_req_items(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  const uint8_t* p = (const uint8_t*)view.buf;
+  const uint8_t* end = p + view.len;
+  Py_ssize_t n = 0;
+  bool fallback = false;
+  while (p < end) {
+    uint64_t tag, len;
+    if (!read_varint(&p, end, &tag) || tag != 0x0A ||
+        !read_varint(&p, end, &len) || (uint64_t)(end - p) < len) {
+      fallback = true;
+      break;
+    }
+    p += len;
+    n++;
+  }
+  PyBuffer_Release(&view);
+  if (fallback) Py_RETURN_NONE;
+  return PyLong_FromSsize_t(n);
+}
+
+// splitmix64 avalanche finalizer — MUST stay bit-identical to
+// hashing.mix64_np / hashing.mix64 (tests/test_native.py pins the
+// parity); the fused ingest applies it inline so the packed key column
+// needs no second numpy pass.
+static inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ULL;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBULL;
+  x ^= x >> 31;
+  return x;
+}
+
+// pack_wire_wave(data, now_ms, a64, a32, m,
+//                duration_max, value_max, eff_max, td_bound) ->
+//   None                              (needs the classic/pb2 path)
+// | (n, khash u64le, khash_raw u64le, behavior_or,
+//    tlv_off u64le, tlv_len u64le)
+//
+// The fused wire ingest: one pass over a GetRateLimitsReq /
+// GetPeerRateLimitsReq that parses, validates, clamps (bit-identical to
+// core/batch.py › pack_columns — the clamp bounds come in as arguments
+// so types.py stays the single source of truth), key-hashes
+// (FNV-1a64 + mix64, zero-remapped) and writes the rows STRAIGHT into a
+// leased pair of packed wave-upload matrices (a64 [8,m] i64 row-major:
+// key,hits,limit,duration,eff_ms,greg_end,burst,now; a32 [3,m] i32:
+// behavior,algorithm,valid — parallel/sharded.py › PACK64/PACK32).
+// Padding rows [n, m) keep empty_batch semantics: the buffers arrive
+// zeroed from the pool and only eff_ms is re-filled to 1 here.
+//
+// Returns None (caller releases the lease and falls back) whenever the
+// batch needs host-side Python: pb2-fallback framing (as
+// parse_get_rate_limits), n > m, or any DURATION_IS_GREGORIAN row
+// (calendar period ends are computed in Python).  GLOBAL/MULTI_REGION
+// gating is the caller's policy — behavior_or is returned for it.
+static PyObject* pack_wire_wave(PyObject*, PyObject* args) {
+  Py_buffer view, b64, b32;
+  long long now_ms;
+  Py_ssize_t m;
+  unsigned long long duration_max, value_max, eff_max, td_bound;
+  if (!PyArg_ParseTuple(args, "y*Lw*w*nKKKK", &view, &now_ms, &b64, &b32,
+                        &m, &duration_max, &value_max, &eff_max,
+                        &td_bound))
+    return nullptr;
+  if (b64.len < m * 8 * (Py_ssize_t)sizeof(int64_t) ||
+      b32.len < m * 3 * (Py_ssize_t)sizeof(int32_t)) {
+    PyBuffer_Release(&view);
+    PyBuffer_Release(&b64);
+    PyBuffer_Release(&b32);
+    PyErr_SetString(PyExc_ValueError, "packed buffers too small");
+    return nullptr;
+  }
+  int64_t* a64 = (int64_t*)b64.buf;  // rows: key hits limit duration
+                                     //       eff_ms greg_end burst now
+  int32_t* a32 = (int32_t*)b32.buf;  // rows: behavior algorithm valid
+  int64_t* r_key = a64;
+  int64_t* r_hits = a64 + m;
+  int64_t* r_limit = a64 + 2 * m;
+  int64_t* r_dur = a64 + 3 * m;
+  int64_t* r_eff = a64 + 4 * m;
+  int64_t* r_burst = a64 + 6 * m;
+  int64_t* r_now = a64 + 7 * m;
+  int32_t* r_beh = a32;
+  int32_t* r_alg = a32 + m;
+  int32_t* r_valid = a32 + 2 * m;
+  for (Py_ssize_t i = 0; i < m; i++) r_eff[i] = 1;  // padding eff_ms
+  const uint8_t* base = (const uint8_t*)view.buf;
+  const uint8_t* p = base;
+  const uint8_t* end = p + view.len;
+  std::vector<uint64_t> khash, khash_raw, tlv_off, tlv_len;
+  khash.reserve(64);
+  uint64_t beh_or = 0;
+  const uint64_t GREG = 4;  // Behavior.DURATION_IS_GREGORIAN
+  bool fallback = false;
+  Py_ssize_t n = 0;
+  while (p < end) {
+    const uint8_t* tlv_start = p;
+    uint64_t tag, len;
+    if (!read_varint(&p, end, &tag) || tag != 0x0A ||
+        !read_varint(&p, end, &len) || (uint64_t)(end - p) < len) {
+      fallback = true;
+      break;
+    }
+    const uint8_t* q = p;
+    const uint8_t* qend = p + len;
+    p = qend;
+    const uint8_t* name_p = nullptr;
+    const uint8_t* key_p = nullptr;
+    uint64_t name_len = 0, key_len = 0;
+    int64_t f_hits = 0, f_limit = 0, f_dur = 0, f_burst = 0;
+    int32_t f_alg = 0, f_beh = 0;
+    while (q < qend && !fallback) {
+      uint64_t t;
+      if (!read_varint(&q, qend, &t)) {
+        fallback = true;
+        break;
+      }
+      uint64_t field = t >> 3, wt = t & 7;
+      if (wt == 2) {
+        uint64_t l;
+        if (!read_varint(&q, qend, &l) || (uint64_t)(qend - q) < l) {
+          fallback = true;
+          break;
+        }
+        if (field == 1) {
+          name_p = q;
+          name_len = l;
+        } else if (field == 2) {
+          key_p = q;
+          key_len = l;
+        } else {
+          fallback = true;
+          break;
+        }
+        q += l;
+      } else if (wt == 0) {
+        uint64_t v;
+        if (!read_varint(&q, qend, &v)) {
+          fallback = true;
+          break;
+        }
+        switch (field) {
+          case 3: f_hits = (int64_t)v; break;
+          case 4: f_limit = (int64_t)v; break;
+          case 5: f_dur = (int64_t)v; break;
+          case 6: f_alg = (int32_t)v; break;
+          case 7: f_beh = (int32_t)v; break;
+          case 8: f_burst = (int64_t)v; break;
+          default: fallback = true;
+        }
+      } else {
+        fallback = true;
+      }
+    }
+    if (fallback) break;
+    if (name_p == nullptr || name_len == 0 || key_p == nullptr ||
+        key_len == 0 || !valid_utf8(name_p, name_len) ||
+        !valid_utf8(key_p, key_len) ||
+        ((uint64_t)(uint32_t)f_beh & GREG) || n >= m) {
+      fallback = true;
+      break;
+    }
+    uint64_t h = fnv1a64(name_p, (Py_ssize_t)name_len);
+    const unsigned char us = '_';
+    h = fnv1a64(&us, 1, h);
+    h = fnv1a64(key_p, (Py_ssize_t)key_len, h);
+    khash_raw.push_back(h);
+    uint64_t hm = mix64(h);
+    if (hm == 0) hm = 1;
+    khash.push_back(hm);
+    tlv_off.push_back((uint64_t)(tlv_start - base));
+    tlv_len.push_back((uint64_t)(qend - tlv_start));
+    // clamps: the exact pack_columns arithmetic (core/batch.py)
+    int64_t dur = f_dur < (int64_t)duration_max ? f_dur
+                                                : (int64_t)duration_max;
+    int64_t eff = dur > 1 ? dur : 1;
+    int leaky = f_alg == 1;
+    uint64_t cap_v = value_max;
+    if (leaky) {
+      if (eff > (int64_t)eff_max) eff = (int64_t)eff_max;
+      uint64_t c = td_bound / (uint64_t)eff;
+      cap_v = c < value_max ? c : value_max;
+    }
+    int64_t lim = f_limit < 0 ? 0 : f_limit;
+    if (lim > (int64_t)cap_v) lim = (int64_t)cap_v;
+    int64_t hits = f_hits < 0 ? 0 : f_hits;
+    if (hits > (int64_t)cap_v) hits = (int64_t)cap_v;
+    int64_t burst = f_burst > 0
+                        ? (f_burst < (int64_t)cap_v ? f_burst
+                                                    : (int64_t)cap_v)
+                        : lim;
+    r_key[n] = (int64_t)hm;
+    r_hits[n] = hits;
+    r_limit[n] = lim;
+    r_dur[n] = dur;
+    r_eff[n] = eff;
+    r_burst[n] = burst;
+    r_now[n] = (int64_t)now_ms;
+    r_beh[n] = f_beh;
+    r_alg[n] = leaky ? 1 : 0;
+    r_valid[n] = 1;
+    beh_or |= (uint64_t)(uint32_t)f_beh;
+    n++;
+  }
+  PyBuffer_Release(&view);
+  PyBuffer_Release(&b64);
+  PyBuffer_Release(&b32);
+  if (fallback) Py_RETURN_NONE;
+  static const char kEmptyW[1] = {0};
+  const char* kh_p = n ? (const char*)khash.data() : kEmptyW;
+  const char* kr_p = n ? (const char*)khash_raw.data() : kEmptyW;
+  const char* to_p = n ? (const char*)tlv_off.data() : kEmptyW;
+  const char* tl_p = n ? (const char*)tlv_len.data() : kEmptyW;
+  return Py_BuildValue("(ny#y#Ky#y#)", n, kh_p, n * 8, kr_p, n * 8,
+                       (unsigned long long)beh_or, to_p, n * 8, tl_p,
+                       n * 8);
+}
+
 // split_resp_items(bytes) ->
 //   None | (n, tlv_off u64le, tlv_len u64le, status i32le)
 // Delimits each repeated field-1 submessage (RateLimitResp) of a
@@ -537,6 +763,11 @@ static PyMethodDef methods[] = {
      "Batch FNV-1a64 of name+'_'+key pairs -> (le64 bytes, n)"},
     {"parse_get_rate_limits", parse_get_rate_limits, METH_O,
      "GetRateLimitsReq wire bytes -> packed column buffers (or None)"},
+    {"count_req_items", count_req_items, METH_O,
+     "Top-level scan: count repeated field-1 request TLVs (or None)"},
+    {"pack_wire_wave", pack_wire_wave, METH_VARARGS,
+     "Fused ingest: wire bytes -> clamped rows written into leased "
+     "packed wave matrices (or None)"},
     {"split_resp_items", split_resp_items, METH_O,
      "RateLimitResp-list wire bytes -> per-item TLV ranges + status"},
     {"build_rate_limit_resps", build_rate_limit_resps, METH_VARARGS,

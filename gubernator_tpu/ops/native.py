@@ -51,6 +51,46 @@ def parse_get_rate_limits(data: bytes):
     }
 
 
+def count_req_items(data: bytes):
+    """Top-level-only TLV count of a GetRateLimitsReq /
+    GetPeerRateLimitsReq, or None on framing the fast lane doesn't
+    model.  Lets the fused ingest size its wave bucket (and lease the
+    packed upload buffers) before the single full parse."""
+    return _native.count_req_items(data)
+
+
+def pack_wire_wave(data: bytes, now_ms: int, a64: np.ndarray,
+                   a32: np.ndarray):
+    """Fused wire ingest: parse + validate + clamp + key-hash (FNV-1a64
+    → mix64, zero-remapped) one request message and write the rows
+    straight into a leased packed wave-upload pair (``a64`` [8, m] i64,
+    ``a32`` [3, m] i32 — parallel/sharded.py › PACK64/PACK32 layout,
+    zeroed by the pool; only the eff_ms padding row is re-filled here).
+
+    Returns None (caller releases the lease and falls back to the
+    classic numpy pack) for anything the lane doesn't model: pb2
+    framing, n > m, or any DURATION_IS_GREGORIAN row.  Otherwise
+    (n, khash u64[n] MIXED, khash_raw u64[n], behavior_or, tlv_off,
+    tlv_len).  Clamp bounds are passed from types.py so the constants
+    have one home; clamp arithmetic is pinned bit-identical to
+    core/batch.py › pack_columns by tests/test_native.py."""
+    from ..types import DURATION_MAX, EFF_MAX, TD_BOUND, VALUE_MAX
+
+    m = a64.shape[1]
+    r = _native.pack_wire_wave(data, int(now_ms), a64, a32, m,
+                               DURATION_MAX, VALUE_MAX, EFF_MAX,
+                               TD_BOUND)
+    if r is None:
+        return None
+    n, kh, kr, beh_or, toff, tlen = r
+    return (n,
+            np.frombuffer(kh, "<u8", count=n),
+            np.frombuffer(kr, "<u8", count=n),
+            int(beh_or),
+            np.frombuffer(toff, "<u8", count=n),
+            np.frombuffer(tlen, "<u8", count=n))
+
+
 def split_resp_items(data: bytes):
     """RateLimitResp-list wire bytes → (tlv_off, tlv_len, status) per
     item, or None on malformed input (caller falls back to pb2).  Works

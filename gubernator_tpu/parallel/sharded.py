@@ -32,8 +32,34 @@ from .mesh import (SHARD_AXIS, XLA_EXEC_MU, make_mesh, shard_map,
 
 log = logging.getLogger("gubernator_tpu.sharded")
 
+try:  # fused C++ wire ingest (ops/_native.cpp); optional
+    from ..ops import native as _wire_native
+except ImportError:  # pragma: no cover - unbuilt extension
+    _wire_native = None
+
 #: TableState value columns addressable by row programs (all but `key`).
 VALUE_COLS = tuple(f for f in TableState._fields if f != "key")
+
+
+class PrepackedWave:
+    """One fused-ingest wave: a leased packed upload pair with rows
+    [0, n) already parsed/clamped/hashed in C++ (pack_wire_wave), plus
+    the per-request metadata the serving lanes gate on.  The holder
+    owns the lease until ``ShardedEngine.check_prepacked`` consumes it
+    (or must release it explicitly on a fallback path)."""
+
+    __slots__ = ("lease", "n", "khash", "khash_raw", "behavior_or",
+                 "tlv_off", "tlv_len")
+
+    def __init__(self, lease, n, khash, khash_raw, behavior_or,
+                 tlv_off, tlv_len):
+        self.lease = lease
+        self.n = n
+        self.khash = khash
+        self.khash_raw = khash_raw
+        self.behavior_or = behavior_or
+        self.tlv_off = tlv_off
+        self.tlv_len = tlv_len
 
 
 def autogrow_limit_per_shard(total_rows: int, n_shards: int,
@@ -583,6 +609,99 @@ class ShardedEngine:
         table_full) host arrays in [n·B] block order."""
         return self._finish_wave(*self._launch_wave(glob, now_ms))
 
+    # ---- fused wire lane (ops/_native.cpp › pack_wire_wave) ------------
+
+    def prepack_wire(self, data: bytes, now_ms: int):
+        """Fused C++ wire ingest: one pass from request wire bytes to a
+        LEASED pair of packed wave-upload matrices — parse, validate,
+        clamp (bit-identical to pack_columns), key-hash (mixed,
+        zero-remapped) and fill, with zero intermediate numpy columns.
+
+        Single-shard meshes only (block order == request order, so the
+        wave needs no shard routing or slot scatter); multi-shard and
+        anything the C++ lane can't model (pb2 framing, Gregorian rows,
+        n over the largest bucket) returns None and the caller takes
+        the classic parse → pack_columns path.
+
+        Returns a PrepackedWave whose lease the caller OWNS: every
+        return path must end in check_prepacked (which releases it) or
+        an explicit ``pre.lease.release()``."""
+        if self.n != 1 or _wire_native is None:
+            return None
+        cnt = _wire_native.count_req_items(data)
+        if not cnt:
+            return None
+        bw = next((b for b in self.wave_buckets if cnt <= b), None)
+        if bw is None:
+            return None  # oversize: classic path splits into waves
+        lease = self.wave_pool.lease(bw)
+        res = _wire_native.pack_wire_wave(data, now_ms, lease.a64,
+                                          lease.a32)
+        if res is None:
+            lease.release()
+            return None
+        n, khash, khash_raw, behavior_or, tlv_off, tlv_len = res
+        return PrepackedWave(lease, n, khash, khash_raw, behavior_or,
+                             tlv_off, tlv_len)
+
+    def check_prepacked(self, pre: "PrepackedWave", now_ms: int) -> tuple:
+        """Launch + resolve a prepacked wave.  Returns the check_packed
+        5-tuple (status i32, limit, remaining, reset, table_full) over
+        rows [0, pre.n) — block order IS request order on the 1-shard
+        mesh, so no slot gather happens.  Releases the lease on every
+        path.  Table-full rows ride the classic sweep-retry path (the
+        erred rows never mutated state, so re-running just them through
+        check_packed is the same recovery check_batch performs)."""
+        n = pre.n
+        lease = pre.lease
+        try:
+            # retry needs the request columns; snapshot them from the
+            # lease ONLY if the cheap error scan demands it (below)
+            launched = self._launch_arrays(lease.a64, lease.a32, now_ms)
+            o_st, o_rem, o_rst, o_lim, o_err = self._finish_wave(
+                *launched)
+            err = o_err[:n]
+            if not err.any():
+                lease.release()
+                lease = None
+                return (o_st[:n].astype(np.int32), o_lim[:n], o_rem[:n],
+                        o_rst[:n], err)
+            # rare path: probe windows exhausted — rebuild the erred
+            # rows as a RequestBatch from the still-leased matrices and
+            # push them through check_packed (sweep-retry/auto-grow
+            # live there; non-erred rows already applied, so only the
+            # erred subset re-runs)
+            ei = np.nonzero(err)[0]
+            a64, a32 = lease.a64, lease.a32
+            sub = RequestBatch(
+                key=a64[0][ei].view(np.uint64),
+                hits=a64[1][ei].copy(), limit=a64[2][ei].copy(),
+                duration=a64[3][ei].copy(), eff_ms=a64[4][ei].copy(),
+                greg_end=a64[5][ei].copy(),
+                behavior=a32[0][ei].copy(), algorithm=a32[1][ei].copy(),
+                burst=a64[6][ei].copy(), valid=a32[2][ei] != 0,
+                now=a64[7][ei].copy())
+            khash_sub = pre.khash[ei]
+            lease.release()
+            lease = None
+            status = o_st[:n].astype(np.int32)
+            lim_o = o_lim[:n].copy()
+            rem_o = o_rem[:n].copy()
+            rst_o = o_rst[:n].copy()
+            full = np.zeros(n, bool)
+            self.sweep(now_ms)
+            r_st, r_lim, r_rem, r_rst, r_full = self.check_packed(
+                sub, khash_sub, now_ms)
+            status[ei] = r_st
+            lim_o[ei] = r_lim
+            rem_o[ei] = r_rem
+            rst_o[ei] = r_rst
+            full[ei] = r_full
+            return status, lim_o, rem_o, rst_o, full
+        finally:
+            if lease is not None:
+                lease.release()
+
     def check_batch(self, reqs: Sequence[RateLimitRequest], now_ms: int
                     ) -> List[RateLimitResponse]:
         """Object-lane entry: pack, run the columnar path, assemble
@@ -831,4 +950,13 @@ class ShardedEngine:
 
         self.state = TableState(**{
             f: jax.device_put(v, sh) for f, v in host.items()})
+        # device_put of an aligned host column is zero-copy on this
+        # image's XLA:CPU without pinning the numpy owner — once `host`
+        # dies the allocator reuses the table's backing memory and live
+        # rows turn into heap garbage (state lost across restart, and
+        # worse: ~1.6k phantom rows evicting real ones).  Pin the
+        # columns for the engine's lifetime; the donated step keeps
+        # writing the state into these same buffers, so the cost is one
+        # table copy (~cap×9×8 bytes), not a leak per wave.
+        self._restore_host_pin = host
         return placed

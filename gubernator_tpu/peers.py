@@ -23,7 +23,7 @@ from typing import Callable, Dict, Generic, List, Optional, TypeVar
 
 import numpy as np
 
-from .hashing import fnv1a64, mixed_fnv1a64
+from .hashing import fnv1a64, mix64, mixed_fnv1a64
 from .types import PeerInfo
 
 P = TypeVar("P")
@@ -81,6 +81,14 @@ class ConsistentHash(Generic[P]):
         if not self._peers:
             raise RuntimeError("picker has no peers")
         return self._peers[h % len(self._peers)]
+
+    def get_by_raw_hash(self, h: int) -> P:
+        """Owner for a RAW FNV-1a64 key hash — the wire lanes' async
+        queue key space (global_manager._hits_raw et al.).  Applies the
+        mix64 finalizer, exactly matching get(key)'s mixed_fnv1a64
+        pipeline, so raw-queue flushes route without materializing key
+        strings.  Same default-hash caveat as get_by_hash."""
+        return self.get_by_hash(mix64(h))
 
     def owner_indices(self, hashes: np.ndarray) -> np.ndarray:
         """Vectorized get_by_hash: int32 index into ``peers()`` order per
@@ -158,6 +166,11 @@ class ReplicatedConsistentHash(Generic[P]):
             idx = 0
         return self._ring_peer[idx]
 
+    def get_by_raw_hash(self, h: int) -> P:
+        """Owner for a RAW FNV-1a64 key hash (see ConsistentHash
+        .get_by_raw_hash)."""
+        return self.get_by_hash(mix64(h))
+
     def owner_indices(self, hashes: np.ndarray) -> np.ndarray:
         """Vectorized get_by_hash over the vnode ring: int32 index into
         ``peers()`` order per uint64 key hash.  np.searchsorted(side=
@@ -226,6 +239,9 @@ class RegionPeerPicker(Generic[P]):
 
     def get_by_hash(self, h: int) -> P:
         return self._local_picker().get_by_hash(h)  # type: ignore
+
+    def get_by_raw_hash(self, h: int) -> P:
+        return self._local_picker().get_by_raw_hash(h)  # type: ignore
 
     def owner_indices(self, hashes: np.ndarray) -> np.ndarray:
         """Vectorized get() over the local region's ring; indices refer

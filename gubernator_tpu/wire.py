@@ -60,6 +60,43 @@ def req_from_tlv(tlv: bytes) -> RateLimitRequest:
     return req_from_pb(pb.RateLimitReq.FromString(tlv[i:i + ln]))
 
 
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def req_to_tlv(r: RateLimitRequest) -> bytes:
+    """Request → one `requests` TLV slice (tag 0x0a + varint length +
+    RateLimitReq payload) — the columnar peer send lanes' entry unit
+    (GetRateLimitsReq.requests and GetPeerRateLimitsReq.requests share
+    field 1, so the slice is valid in either frame)."""
+    payload = req_to_pb(r).SerializeToString()
+    return b"\x0a" + _varint(len(payload)) + payload
+
+
+def tlv_with_hits(tlv: bytes, hits: int) -> bytes:
+    """A request TLV slice with its ``hits`` replaced by the aggregated
+    value — WITHOUT parsing the payload: a fresh field-3 varint is
+    appended (proto3 last-value-wins for scalar fields; both pb2 and the
+    C++ lane honor it) and the outer length is rebuilt.  This is how the
+    GLOBAL hit flush sends per-key aggregates from raw queued TLVs with
+    zero request materialization."""
+    i, shift, ln = 1, 0, 0
+    while True:
+        b = tlv[i]
+        ln |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            break
+        shift += 7
+    payload = tlv[i:i + ln] + b"\x18" + _varint(int(hits))
+    return b"\x0a" + _varint(len(payload)) + payload
+
+
 def resp_to_pb(r: RateLimitResponse) -> pb.RateLimitResp:
     m = pb.RateLimitResp(
         status=int(r.status), limit=int(r.limit), remaining=int(r.remaining),

@@ -377,7 +377,8 @@ def test_result_timeout_env_override(engine, monkeypatch):
         assert Dispatcher.RESULT_TIMEOUT_S == 120.0  # class untouched
     finally:
         d.close()
-    for bad in ("not-a-number", "0", "-5", "nan"):
+    for bad in ("not-a-number", "0", "-5", "nan", "inf", "-inf",
+                "Infinity"):
         monkeypatch.setenv("GUBER_RESULT_TIMEOUT_S", bad)
         d = Dispatcher(engine)
         try:

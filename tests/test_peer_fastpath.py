@@ -1,0 +1,258 @@
+"""Columnar peer fast path (ISSUE 3): the pooled per-peer send lanes,
+depth-K pipelined forward RPCs, retry → circuit-open → fail-fast, and
+the fused owner-side wire ingest.
+
+Pinned here:
+- forwarded responses are BYTE-identical to local wire serving and
+  field-identical to the pure-Python OracleEngine;
+- exact hit conservation under 16 concurrent callers spread over a
+  3-daemon cluster (shared keys debit once per hit, ring-global);
+- a peer dying mid-stream degrades to per-request error responses
+  (bounded time, no stuck futures), opens the circuit after the
+  configured consecutive failures (subsequent sends fail fast), and
+  recovers through the half-open probe once the peer returns.
+"""
+import time
+
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.config import BehaviorConfig, Config
+from gubernator_tpu.instance import V1Instance
+from gubernator_tpu.oracle import Oracle
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.types import Algorithm, RateLimitRequest
+
+pytest.importorskip("gubernator_tpu.ops._native",
+                    reason="columnar peer lanes need the C++ codec")
+
+DAY = 24 * 3_600_000
+NOW0 = 1_760_000_000_000
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from gubernator_tpu.parallel import make_mesh
+
+    return make_mesh(n=1)
+
+
+def serialize(reqs):
+    msg = pb.GetRateLimitsReq()
+    for r in reqs:
+        m = msg.requests.add()
+        m.name = r.name
+        m.unique_key = r.unique_key
+        m.hits = r.hits
+        m.limit = r.limit
+        m.duration = r.duration
+        m.algorithm = int(r.algorithm)
+        m.behavior = int(r.behavior)
+        m.burst = r.burst
+    return msg.SerializeToString()
+
+
+def mk_wave(w: int, name="pfp"):
+    reqs = []
+    for i in range(30):
+        reqs.append(RateLimitRequest(
+            name=name, unique_key=f"t{i}", hits=1 + (i + w) % 3, limit=9,
+            duration=DAY, algorithm=Algorithm.TOKEN_BUCKET))
+    for i in range(10):
+        reqs.append(RateLimitRequest(
+            name=name, unique_key=f"l{i}", hits=2, limit=40,
+            duration=DAY, algorithm=Algorithm.LEAKY_BUCKET, burst=12))
+    for i in range(5):  # in-batch duplicates: segment semantics must
+        # survive the forward/merge round trip
+        reqs.append(RateLimitRequest(
+            name=name, unique_key=f"t{i}", hits=2, limit=9,
+            duration=DAY, algorithm=Algorithm.TOKEN_BUCKET))
+    return reqs
+
+
+class TestForwardedByteParity:
+    """A worker-only daemon (ring omits itself) forwards EVERY request
+    over the columnar lane; its response bytes must equal a solo
+    instance serving the same stream locally, and both must match the
+    oracle."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        c = cluster_mod.start(2)
+        owner, worker = c.daemon_at(0), c.daemon_at(1)
+        owner.set_peers([owner.peer_info()])
+        worker.set_peers([owner.peer_info()])
+        yield c
+        c.stop()
+
+    def test_forwarded_bytes_equal_local_and_oracle(self, pair, mesh,
+                                                    monkeypatch):
+        worker = pair.instance_at(1)
+        solo = V1Instance(Config(cache_size=1 << 12,
+                                 sweep_interval_ms=0), mesh=mesh)
+        try:
+            oracle = Oracle()
+            for w in range(3):
+                # the peer wire stamps forwarded batches with the
+                # OWNER's clock; pin it (in-process cluster — one
+                # module) so parity is exact down to reset_time bytes
+                monkeypatch.setattr(
+                    "gubernator_tpu.instance.clock_ms",
+                    lambda w=w: NOW0 + w)
+                reqs = mk_wave(w)
+                data = serialize(reqs)
+                fwd = worker.get_rate_limits_wire(data, now_ms=NOW0 + w)
+                loc = solo.get_rate_limits_wire(data, now_ms=NOW0 + w)
+                assert fwd == loc, f"wave {w}: forwarded bytes differ " \
+                    "from local wire serving"
+                want = oracle.check_batch(reqs, NOW0 + w)
+                got = pb.GetRateLimitsResp.FromString(fwd)
+                assert len(got.responses) == len(reqs)
+                for i, (g, e) in enumerate(zip(got.responses, want)):
+                    assert g.error == "", (w, i, g.error)
+                    assert (int(g.status), int(g.remaining),
+                            int(g.limit), int(g.reset_time)) == \
+                        (int(e.status), int(e.remaining),
+                         int(e.limit), int(e.reset_time)), (w, i)
+        finally:
+            solo.close()
+
+
+class TestConcurrentConservation:
+    """16 concurrent callers spread over a 3-daemon cluster hammer a
+    small shared key set: every hit must debit exactly once
+    cluster-wide (ring ownership + the pooled forward lanes must not
+    lose, duplicate, or misroute a request)."""
+
+    def test_exact_conservation_16_callers(self):
+        import threading
+
+        c = cluster_mod.start(3)
+        try:
+            n_threads, reps, hits = 16, 12, 3
+            keys = [f"c{i}" for i in range(4)]
+            limit = 10 ** 6
+
+            def one(hits_, key):
+                return serialize([RateLimitRequest(
+                    name="cons", unique_key=key, hits=hits_,
+                    limit=limit, duration=DAY)])
+
+            # warm every daemon's engine + forward lanes
+            for d in range(3):
+                for k in keys:
+                    c.instance_at(d).get_rate_limits_wire(
+                        one(0, k), now_ms=NOW0)
+            errs = []
+
+            def worker(t):
+                inst = c.instance_at(t % 3)
+                try:
+                    for r in range(reps):
+                        out = pb.GetRateLimitsResp.FromString(
+                            inst.get_rate_limits_wire(
+                                one(hits, keys[(t + r) % len(keys)]),
+                                now_ms=NOW0 + 1 + r))
+                        assert out.responses[0].error == ""
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            ths = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join(timeout=120)
+            assert not any(th.is_alive() for th in ths), "stuck caller"
+            assert not errs, errs[:3]
+            total = 0
+            for k in keys:
+                q = pb.GetRateLimitsResp.FromString(
+                    c.instance_at(0).get_rate_limits_wire(
+                        one(0, k), now_ms=NOW0 + 100))
+                total += limit - int(q.responses[0].remaining)
+            assert total == n_threads * reps * hits, \
+                f"conservation broken: {total} != " \
+                f"{n_threads * reps * hits}"
+        finally:
+            c.stop()
+
+
+class TestPeerDeathCircuit:
+    """Peer death mid-stream: bounded-time error responses (retry with
+    backoff first), circuit-open after the threshold, fail-fast while
+    open, half-open recovery when the peer returns."""
+
+    @pytest.fixture()
+    def fast_circuit(self):
+        return BehaviorConfig(batch_timeout_ms=200, batch_wait_ms=100,
+                              peer_retry_limit=1,
+                              peer_retry_backoff_ms=5,
+                              peer_circuit_threshold=2,
+                              peer_circuit_cooldown_ms=700)
+
+    def test_retry_circuit_failfast_recover(self, fast_circuit):
+        c = cluster_mod.start(2, behaviors=fast_circuit)
+        try:
+            inst = c.instance_at(0)
+            # keys owned by daemon 1 (they will be forwarded)
+            owned1 = []
+            for i in range(300):
+                k = f"d{i}"
+                if c.owner_daemon_of("pd_" + k) is c.daemon_at(1):
+                    owned1.append(k)
+                if len(owned1) >= 3:
+                    break
+            assert len(owned1) >= 3
+            peer1 = next(p for p in inst.peers()
+                         if not inst.is_self(p))
+
+            def fire(key):
+                t0 = time.monotonic()
+                out = pb.GetRateLimitsResp.FromString(
+                    inst.get_rate_limits_wire(serialize(
+                        [RateLimitRequest(name="pd", unique_key=key,
+                                          hits=1, limit=10,
+                                          duration=DAY)]),
+                        now_ms=NOW0))
+                return out.responses[0], time.monotonic() - t0
+
+            r, _ = fire(owned1[0])
+            assert r.error == ""  # healthy forward first
+            c.daemon_at(1).close()
+            # dead peer: every forward degrades to an error response in
+            # bounded time (connection-refused fails fast; retries add
+            # only the short backoff), never a stuck future
+            deadline = time.monotonic() + 30
+            while not peer1.circuit_open():
+                assert time.monotonic() < deadline, \
+                    "circuit never opened"
+                r, dt = fire(owned1[1])
+                assert "while fetching rate limit from peer" in r.error
+                assert dt < 10, f"forward took {dt:.1f}s"
+            # fail-fast while open: no RPC, so the error returns in
+            # well under a connection timeout
+            r, dt = fire(owned1[2])
+            assert "while fetching rate limit from peer" in r.error
+            assert dt < 0.5, f"circuit-open forward took {dt:.3f}s"
+            m = inst.metrics
+            assert m.peer_circuit_open_counter.labels(
+                peer_addr=peer1.info.grpc_address)._value.get() >= 1
+            assert m.peer_retry_counter.labels(
+                peer_addr=peer1.info.grpc_address)._value.get() >= 1
+            # recovery: bring the peer back on the same address, wait
+            # out the cooldown, and the half-open probe flush closes
+            # the circuit
+            c.restart(1)
+            peer1b = next(p for p in c.instance_at(0).peers()
+                          if not c.instance_at(0).is_self(p))
+            deadline = time.monotonic() + 30
+            while True:
+                time.sleep(0.2)
+                r, _ = fire(owned1[1])
+                if r.error == "" and not peer1b.circuit_open():
+                    break
+                assert time.monotonic() < deadline, \
+                    f"circuit never recovered (last error: {r.error!r})"
+        finally:
+            c.stop()

@@ -18,6 +18,10 @@ FULL untruncated error text written to /tmp/pallas_probe.json:
 Single-client rule: run ONLY when no other jax process holds the relay.
 
     timeout 1800 python tools/pallas_probe.py
+
+Results land at /tmp/pallas_probe.<pid>.json (PID-suffixed so parallel
+probes can't clobber each other); GUBER_PALLAS_PROBE_OUT overrides —
+driving batteries set it and read the same path back.
 """
 import json
 import os
@@ -32,7 +36,13 @@ import _jax_cache
 
 _jax_cache.setup()
 
-OUT = "/tmp/pallas_probe.json"
+#: PID-suffixed by default (as bench.py's section files are): two
+#: probes on one host must not clobber — or cross-salvage — each
+#: other's checkpoints.  Drivers that consume the file (e.g.
+#: tools/tpu_followup_r5b.py) pass an explicit path through
+#: GUBER_PALLAS_PROBE_OUT.
+OUT = os.environ.get("GUBER_PALLAS_PROBE_OUT",
+                     f"/tmp/pallas_probe.{os.getpid()}.json")
 res: dict = {"started": time.strftime("%Y-%m-%d %H:%M:%S")}
 
 

@@ -143,12 +143,17 @@ def main() -> int:
         record("abort", "relay died during capab_p8_25")
         return 1
 
-    # 3. the Mosaic compile diagnosis
+    # 3. the Mosaic compile diagnosis.  The probe PID-suffixes its
+    # checkpoint by default; pass an explicit path through so we read
+    # back exactly the file THIS child wrote (a fixed /tmp name could
+    # be another probe's stale checkpoint — ADVICE r5)
     t = time.time()
+    probe_out = f"/tmp/pallas_probe.{os.getpid()}.json"
     run_stage("pallas_probe",
               [sys.executable, os.path.join(_HERE, "pallas_probe.py")],
-              timeout=1800)
-    merge("pallas_probe", "/tmp/pallas_probe.json", t)
+              timeout=1800,
+              env_extra={"GUBER_PALLAS_PROBE_OUT": probe_out})
+    merge("pallas_probe", probe_out, t)
     if not relay_alive():
         record("abort", "relay died during pallas_probe")
         return 1

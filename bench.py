@@ -591,6 +591,72 @@ def _telemetry_rows(inst) -> dict:
         return {"error": (str(e) or repr(e))[:200]}
 
 
+def _analytics_rows(inst) -> dict:
+    """ISSUE 4: the /debug/topkeys + /debug/phases snapshot for the
+    BENCH row — which keys were hot and where the milliseconds went,
+    auditable from the JSON alone.  Truncated to the heaviest 16."""
+    ana = inst.analytics
+    if ana is None:
+        return {"skipped": "analytics disabled (GUBER_ANALYTICS=0)"}
+    try:
+        ana.flush(timeout=5.0)
+        snap = ana.topkeys_snapshot(16)
+        snap["phases"] = ana.phases_snapshot()["phases"]
+        return snap
+    except Exception as e:  # noqa: BLE001 - analytics must not cost rows
+        return {"error": (str(e) or repr(e))[:200]}
+
+
+def _analytics_ab(inst, call, pairs=5, reps=30) -> dict:
+    """ISSUE 4 acceptance: the analytics tap must cost < 3 % throughput.
+    Interleaved on/off timing pairs of the same call — detaching the
+    ONE dispatcher.analytics reference darkens every tap — with the
+    median of per-pair ratios cancelling the shared host's drift.  The
+    ON arm flushes the worker's paced backlog before the OFF arm is
+    timed (deferred fold work must not leak into the baseline), and an
+    untimed warmup pair absorbs first-use costs (label children, fold
+    buffers).  Skipped when analytics is off (no baseline)."""
+    disp = inst.dispatcher
+    ana = disp.analytics
+    if ana is None:
+        return {"skipped": "no analytics attached (GUBER_ANALYTICS=0)"}
+
+    def rate():
+        t0 = time.perf_counter()
+        for r in range(reps):
+            call(r)
+        return reps / (time.perf_counter() - t0)
+
+    try:
+        ratios, on_r, off_r = [], [], []
+        for pair in range(pairs + 1):
+            disp.analytics = ana
+            on = rate()
+            ana.flush(timeout=5.0)
+            disp.analytics = None
+            off = rate()
+            if pair == 0:
+                continue  # warmup pair, untimed
+            ratios.append(off / on)
+            on_r.append(on)
+            off_r.append(off)
+        overhead = (float(np.median(ratios)) - 1.0) * 100
+        row = {"overhead_pct": round(overhead, 2),
+               "overhead_ok": bool(overhead < 3.0),
+               "on_calls_per_s": round(float(np.median(on_r)), 1),
+               "off_calls_per_s": round(float(np.median(off_r)), 1),
+               "pairs": pairs, "reps": reps}
+        if not row["overhead_ok"]:
+            row["warning"] = ("analytics tap measured above the 3% "
+                              "budget on this run; single-host noise — "
+                              "re-run before acting on it")
+        return row
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+    finally:
+        disp.analytics = ana
+
+
 def _serialize_reqs(reqs_lists):
     """[[RateLimitRequest]] → serialized GetRateLimitsReq bytes."""
     from gubernator_tpu.proto import gubernator_pb2 as pb
@@ -891,6 +957,15 @@ def _sec_svc():
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["host_glue_error"] = (
                 str(e) or repr(e))[:200]
+        # ISSUE 4: tap overhead A/B on the wire lane (<3%, skip-if-no-
+        # baseline) — same request bytes as the measured loops above
+        try:
+            out["6_service_path"]["analytics_ab"] = _analytics_ab(
+                inst, lambda r: inst.get_rate_limits_wire(
+                    datas[r % 4], now_ms=NOW0 + 500 + r))
+        except Exception as e:  # noqa: BLE001
+            out["6_service_path"]["analytics_ab"] = {
+                "error": (str(e) or repr(e))[:200]}
         _section_checkpoint(out)
         # peer-forwarding path: what the owner-side apply of a
         # forwarded batch takes, via its wire lane (since ISSUE 3 the
@@ -926,10 +1001,17 @@ def _sec_svc():
                     "1-core build host (CPU backend, median of 3 "
                     "same-harness runs; run-to-run spread ~±15% on "
                     "this shared host) — PERF.md §9")}
+            # ISSUE 4: tap overhead A/B on the forwarded-hop apply path
+            out["8_peer_path"]["analytics_ab"] = _analytics_ab(
+                inst, lambda r: inst.get_peer_rate_limits_wire(
+                    pdatas[r % 4], now_ms=NOW0 + 600 + r))
         except Exception as e:  # noqa: BLE001
             out["8_peer_path"] = {"error": (str(e) or repr(e))[:200]}
         if "6_service_path" in out:
             out["6_service_path"]["telemetry"] = _telemetry_rows(inst)
+            # ISSUE 4: which keys were hot + where the ms went, straight
+            # in the BENCH row (top-16 of the ledger + the phase ledger)
+            out["6_service_path"]["analytics"] = _analytics_rows(inst)
     finally:
         inst.close()
     return out

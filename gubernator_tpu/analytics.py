@@ -1,0 +1,648 @@
+"""Key-level analytics: heavy-hitter ledger + per-phase latency ledger.
+
+ISSUE 4: after the wave telemetry of ISSUE 1 the serving loop's
+*aggregate* health is visible, but not WHICH keys are hot, which drive
+OVER_LIMIT, or where a request's milliseconds go between ingest, queue,
+device and peer forward.  Hot-key skew is the dominant failure mode of
+distributed limiters (PAPERS.md), and the hot-set promoter
+(parallel/hotset.py) needs exactly this hotness signal.
+
+Two pieces, both bounded-memory and OFF the caller's critical path:
+
+- ``HeavyHitterSketch``: a columnar Space-Saving ledger of ``width``
+  counters (GUBER_SKETCH_WIDTH, default 4×K) reporting the top ``K``
+  keys (GUBER_TOPK, default 256).  Exact when the key domain fits in
+  ``width``; otherwise every reported count over-estimates by at most
+  its per-key ``err`` field, itself bounded by ``total_weight/width``
+  (the classic Space-Saving guarantee).  Per key it tracks hits,
+  OVER_LIMIT count, last-seen wall time, and the key NAME when a wave
+  carried one (object-lane taps; pure-columnar wire waves only know
+  the 64-bit khash).
+
+- ``PhaseLedger``: per-phase duration attribution (ingest, pack,
+  queue_wait, device, resolve, build, peer_flush) feeding both the
+  ``gubernator_phase_duration{phase=...}`` histograms and the
+  ``GET /debug/phases`` percentile snapshot.  The in-wave phases
+  (pack, device, resolve) partition the existing
+  ``gubernator_dispatcher_wave_duration`` exactly (asserted by
+  tests/test_telemetry.py).
+
+``KeyAnalytics`` owns both plus the tap queue: the dispatcher enqueues
+cheap column COPIES after each wave resolves, and a single worker
+thread does all unique/aggregate/sketch work, draining the queue in
+paced batches (one vectorized fold per ``BATCH_INTERVAL_S`` window) —
+a full queue drops the wave (counted) rather than ever blocking a
+caller.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+#: phase label set (OBSERVABILITY.md › phase catalog).  ``IN_WAVE``
+#: phases partition wave_duration; the rest attribute time outside the
+#: wave (job queue wait, wire ingest, response build, peer flush).
+IN_WAVE_PHASES = ("pack", "device", "resolve")
+PHASES = ("ingest", "pack", "queue_wait", "device", "resolve", "build",
+          "peer_flush")
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    raw = os.environ.get(name, "")
+    if raw:
+        try:
+            return max(int(raw), lo)
+        except ValueError:
+            pass  # malformed: keep the default
+    return default
+
+
+class HeavyHitterSketch:
+    """Space-Saving heavy hitters over 64-bit key hashes, columnar.
+
+    ``width`` counters total; ``topk()`` reports the heaviest ``k``.
+    Storage is parallel numpy columns (count/err/over/last/khash) with
+    a sorted-hash index rebuilt lazily per wave, so a whole wave folds
+    in with vectorized ops — no per-key Python loop on the columnar
+    path (the dict-of-slots + min-scan variant cost ~40 ms per
+    1000-req Zipf wave; this is ~0.2 ms, which matters on small hosts
+    where the worker thread competes with serving for cores).
+
+    Admission when full follows EXACT sequential Space-Saving
+    semantics (each newcomer evicts the then-minimum slot and inherits
+    its count as the overestimate bound ``err``), simulated for a
+    whole wave with a sorted-victims/FIFO merge instead of a heap —
+    see the comment at the admission step.  The classic guarantees
+    hold, all deterministic:
+
+    - exact (every ``err`` == 0) while the observed key domain fits in
+      ``width``;
+    - per tracked key: ``true <= count`` and ``count - true <= err``;
+    - tracked counts sum to ``total_weight`` exactly, hence
+      ``err <= error_bound()`` (the current minimum)
+      ``<= total_weight/width`` by pigeonhole — and any key whose true
+      count exceeds ``total_weight/width`` is guaranteed tracked.
+
+    NOT thread-safe: KeyAnalytics serializes access on its worker
+    thread (snapshot readers take its lock).
+    """
+
+    def __init__(self, k: int = 256, width: Optional[int] = None):
+        self.k = max(int(k), 1)
+        self.width = max(int(width) if width else 4 * self.k, self.k)
+        w = self.width
+        self._cnt = np.zeros(w, np.int64)
+        self._err = np.zeros(w, np.int64)
+        self._over = np.zeros(w, np.int64)
+        self._last = np.zeros(w, np.int64)
+        self._kh = np.zeros(w, np.uint64)
+        self._used = 0
+        self._sorted_kh = np.empty(0, np.uint64)
+        self._sorted_slot = np.empty(0, np.int64)
+        self._dirty = False  # membership changed since last reindex
+        self.total_weight = 0
+        #: bounded khash → "name_unique_key" side table: names seen on
+        #: object-lane waves resolve keys that later go hot through the
+        #: columnar wire lanes (which only carry hashes)
+        self._names: Dict[int, str] = {}
+        self._names_cap = max(8 * self.width, 4096)
+
+    def __len__(self) -> int:
+        return self._used
+
+    # ---- ingest ---------------------------------------------------------
+
+    def _reindex(self) -> None:
+        if self._dirty or self._sorted_kh.size != self._used:
+            order = np.argsort(self._kh[:self._used])
+            self._sorted_kh = self._kh[:self._used][order]
+            self._sorted_slot = order.astype(np.int64)
+            self._dirty = False
+
+    def update(self, khash: np.ndarray, hits: np.ndarray,
+               over: np.ndarray, t_ms: int,
+               names: Optional[List[Optional[str]]] = None) -> None:
+        """Fold one wave's columns in.  ``khash`` uint64, ``hits``
+        weights (clamped >= 1 so hits=0 status queries still register
+        presence), ``over`` truthy where the decision was OVER_LIMIT.
+        ``names``, when given, aligns with ``khash``."""
+        n = len(khash)
+        if n == 0:
+            return
+        w = np.maximum(np.asarray(hits, np.int64), 1)
+        kh = np.asarray(khash, np.uint64)
+        ob = np.asarray(over, bool)
+        # sort-and-reduceat aggregation (np.unique + ufunc.at is ~2×
+        # slower; this update is the analytics worker's hot loop).
+        # Weight-1 waves — the common columnar shape — skip the
+        # argsort permutation entirely: counts are plain run lengths
+        # of the sorted hashes, and the (sparse) over-limit rows
+        # aggregate separately and scatter in by binary search.
+        if names is None and int(w.max()) == 1:
+            ks = np.sort(kh)
+            starts = np.nonzero(np.concatenate(
+                ([True], ks[1:] != ks[:-1])))[0]
+            uniq = ks[starts]
+            wsum = np.diff(np.append(starts, ks.size))
+            osum = np.zeros(uniq.size, np.int64)
+            if ob.any():
+                kho = np.sort(kh[ob])
+                so = np.nonzero(np.concatenate(
+                    ([True], kho[1:] != kho[:-1])))[0]
+                osum[np.searchsorted(uniq, kho[so])] = \
+                    np.diff(np.append(so, kho.size))
+        else:
+            o = ob.astype(np.int64)
+            sort = np.argsort(kh, kind="stable")
+            ks = kh[sort]
+            starts = np.nonzero(np.concatenate(
+                ([True], ks[1:] != ks[:-1])))[0]
+            uniq = ks[starts]
+            wsum = np.add.reduceat(w[sort], starts)
+            osum = np.add.reduceat(o[sort], starts)
+            if names is not None:
+                # object-lane waves only (small): remember each unique
+                # key's name so columnar taps resolve it at report time
+                rep = sort[starts]  # any occurrence names the key
+                for j in range(uniq.size):
+                    name = names[int(rep[j])]
+                    if name is not None:
+                        self._note_name(int(uniq[j]), name)
+        self.total_weight += int(wsum.sum())
+        # tracked keys: one sorted-membership probe, vectorized folds
+        self._reindex()
+        if self._sorted_kh.size:
+            pos = np.minimum(np.searchsorted(self._sorted_kh, uniq),
+                             self._sorted_kh.size - 1)
+            tracked = self._sorted_kh[pos] == uniq
+            slots = self._sorted_slot[pos[tracked]]
+            self._cnt[slots] += wsum[tracked]
+            self._over[slots] += osum[tracked]
+            self._last[slots] = t_ms
+        else:
+            tracked = np.zeros(uniq.size, bool)
+        m = int(uniq.size - tracked.sum())
+        if m == 0:
+            return
+        new_kh = uniq[~tracked]
+        new_w = wsum[~tracked]
+        new_o = osum[~tracked]
+        free = self.width - self._used
+        if free > 0:
+            take = min(free, m)
+            sl = np.arange(self._used, self._used + take)
+            self._kh[sl] = new_kh[:take]
+            self._cnt[sl] = new_w[:take]
+            self._err[sl] = 0
+            self._over[sl] = new_o[:take]
+            self._last[sl] = t_ms
+            self._used += take
+            self._dirty = True
+            if take == m:
+                return
+            new_kh, new_w, new_o = (new_kh[take:], new_w[take:],
+                                    new_o[take:])
+            m -= take
+        # EXACT sequential Space-Saving admission (each newcomer
+        # evicts the then-minimum slot and inherits its count as the
+        # error bound).  Arrival order within a wave is ours to
+        # choose, so split by weight: the few heavy newcomers run the
+        # exact two-way merge; the weight-1 tail — the dominant churn
+        # shape — admits via closed-form water-filling with no
+        # per-item loop at all.  Either way the counts sum to the
+        # total observed weight, hence err <= min <= total/width.
+        heavy = new_w > 1
+        if heavy.any():
+            self._admit_merge(new_kh[heavy], new_w[heavy],
+                              new_o[heavy], t_ms)
+        light = ~heavy
+        if light.any():
+            self._admit_level(new_kh[light], new_o[light], t_ms)
+
+    def _admit_merge(self, new_kh, new_w, new_o, t_ms: int) -> None:
+        """Sequential Space-Saving for arbitrary weights, simulated as
+        a two-way merge: processing newcomers in ascending-weight
+        order makes both the popped minima v_1 <= v_2 <= ... and the
+        re-inserted values v_j + w_j nondecreasing, so the "heap" is
+        just the sorted victim counts + a FIFO of intra-wave
+        re-insertions.  A slot popped from the FIFO re-evicts an
+        earlier newcomer of this same wave (its assignment is simply
+        overwritten).  Evicted keys' over-limit tallies do NOT carry
+        over, so `over` stays exact per tracked period."""
+        order = np.argsort(new_w, kind="stable")
+        new_kh, new_w, new_o = new_kh[order], new_w[order], new_o[order]
+        sort_idx = np.argsort(self._cnt[: self._used])
+        scnt = self._cnt[: self._used][sort_idx].tolist()
+        sslot = sort_idx.tolist()
+        ns = len(scnt)
+        si = qi = 0
+        qv: list = []  # FIFO as append-only lists + head index (qi):
+        qs: list = []  # stays sorted, so no heap is ever needed
+        assign: Dict[int, int] = {}  # slot → newcomer idx (last wins)
+        inherited: Dict[int, int] = {}  # slot → evicted count
+        for j, wj in enumerate(new_w.tolist()):
+            if qi < len(qv) and (si >= ns or qv[qi] <= scnt[si]):
+                v, slot = qv[qi], qs[qi]
+                qi += 1
+            else:
+                v, slot = scnt[si], sslot[si]
+                si += 1
+            assign[slot] = j
+            inherited[slot] = v
+            qv.append(v + wj)
+            qs.append(slot)
+        slots = np.fromiter(assign.keys(), np.int64, len(assign))
+        js = np.fromiter(assign.values(), np.int64, len(assign))
+        vs = np.fromiter(inherited.values(), np.int64, len(inherited))
+        self._kh[slots] = new_kh[js]
+        self._cnt[slots] = vs + new_w[js]
+        self._err[slots] = vs
+        self._over[slots] = new_o[js]
+        self._last[slots] = t_ms
+        self._dirty = True
+
+    def _admit_level(self, new_kh, new_o, t_ms: int) -> None:
+        """Weight-1 newcomers via exact water-filling: s pops of
+        "evict the minimum, reinsert min+1" ARE s increments of the
+        global minimum, so the final counts are the level-fill of the
+        sorted counts — raise the lowest t0 counts to a common level L
+        (the first r of them to L+1) — computed in closed form.
+        Raised slots take newcomer keys with err = count - 1; the
+        s - raised singletons admitted-then-re-evicted inside the wave
+        vanish, exactly as sequential processing would have them."""
+        s = len(new_kh)
+        used = self._used
+        cnt = self._cnt[:used]
+        order = np.argsort(cnt)
+        c = cnt[order]
+        csum = np.cumsum(c)
+        # cost[i] = lifting slots 0..i to level c[i]; nondecreasing
+        cost = (np.arange(1, used + 1) * c) - csum
+        t0 = int(np.searchsorted(cost, s, side="right"))
+        pool = s + int(csum[t0 - 1])
+        level = pool // t0
+        r = pool - level * t0
+        newvals = np.full(t0, level, np.int64)
+        newvals[:r] += 1
+        changed = newvals > c[:t0]
+        nraised = int(changed.sum())
+        slots = order[:t0][changed]
+        self._cnt[slots] = newvals[changed]
+        self._err[slots] = newvals[changed] - 1
+        self._kh[slots] = new_kh[:nraised]
+        self._over[slots] = new_o[:nraised]
+        self._last[slots] = t_ms
+        self._dirty = True
+
+    def _note_name(self, kh: int, name: str) -> None:
+        names = self._names
+        if kh not in names and len(names) >= self._names_cap:
+            # bounded: drop an arbitrary half when full (plain dicts
+            # pop in insertion order, so this sheds the oldest names)
+            for old in list(names)[: self._names_cap // 2]:
+                del names[old]
+        names[kh] = name
+
+    # ---- reporting ------------------------------------------------------
+
+    def error_bound(self) -> int:
+        """Worst-case overestimate for a newly admitted key: the
+        current minimum tracked count (<= total_weight/width).  0
+        while the ledger has free slots (everything exact)."""
+        if self._used < self.width:
+            return 0
+        return int(self._cnt[: self._used].min())
+
+    def topk(self, k: Optional[int] = None) -> List[dict]:
+        k = self.k if k is None else max(int(k), 1)
+        k = min(k, self._used)
+        cnt = self._cnt[: self._used]
+        if k < self._used:
+            part = np.argpartition(cnt, self._used - k)[self._used - k:]
+            order = part[np.argsort(cnt[part])[::-1]]
+        else:
+            order = np.argsort(cnt)[::-1]
+        out = []
+        for s in order[:k]:
+            kh = int(self._kh[s])
+            out.append({"khash": kh, "key": self._names.get(kh),
+                        "hits": int(self._cnt[s]),
+                        "err": int(self._err[s]),
+                        "over_limit": int(self._over[s]),
+                        "last_seen_ms": int(self._last[s])})
+        return out
+
+
+class PhaseLedger:
+    """Thread-safe per-phase duration aggregation: cumulative count/sum
+    plus a bounded recent-sample window for percentile snapshots
+    (prometheus histograms can't answer percentile queries)."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._mu = threading.Lock()
+        self._agg: Dict[str, list] = {}  # phase → [count, total_s]
+        self._recent: Dict[str, deque] = {}
+        self._maxlen = maxlen
+
+    def observe(self, phase: str, seconds: float) -> None:
+        with self._mu:
+            a = self._agg.get(phase)
+            if a is None:
+                a = self._agg[phase] = [0, 0.0]
+                self._recent[phase] = deque(maxlen=self._maxlen)
+            a[0] += 1
+            a[1] += seconds
+            self._recent[phase].append(seconds)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._mu:
+            out = {}
+            for phase, (count, total) in self._agg.items():
+                xs = np.asarray(self._recent[phase], float)
+                out[phase] = {
+                    "count": count,
+                    "total_ms": round(total * 1e3, 3),
+                    "p50_ms": round(float(np.percentile(xs, 50)) * 1e3, 4),
+                    "p99_ms": round(float(np.percentile(xs, 99)) * 1e3, 4),
+                    "max_ms": round(float(xs.max()) * 1e3, 4),
+                }
+            return out
+
+
+class _Flush:
+    """Queue sentinel: the worker sets the event when it reaches it."""
+
+    def __init__(self):
+        self.done = threading.Event()
+
+
+class KeyAnalytics:
+    """The analytics subsystem: tap queue + worker + sketch + phases.
+
+    Taps copy the wave's (khash, hits, status) columns — a few KB — and
+    enqueue; ``tap_reqs`` enqueues the request/response object lists
+    (the worker hashes names there, recovering key names).  A full
+    queue DROPS the wave and counts it: analytics must never apply
+    backpressure to the serving path.
+    """
+
+    #: worker pacing: after folding a drained batch, rest this long.
+    #: Everything queued in the window folds in ONE vectorized update,
+    #: amortizing the per-update fixed costs — and bounding the
+    #: worker's GIL duty cycle, which on small hosts otherwise convoys
+    #: the serving thread's C sections.
+    BATCH_INTERVAL_S = 0.1
+
+    #: top-K gauge refresh cadence: the label-set diff walks every
+    #: tracked key, so it runs on this timer (and on flush/scrape),
+    #: never per fold.
+    PUBLISH_INTERVAL_S = 2.0
+
+    def __init__(self, metrics=None, k: Optional[int] = None,
+                 width: Optional[int] = None, queue_cap: int = 512,
+                 clock=time.time):
+        self.metrics = metrics
+        #: per-phase histogram children resolved once — .labels() per
+        #: sample is a lock + dict walk on the serving path
+        self._phase_hist: Dict[str, object] = {}
+        self._clock = clock
+        k = k if k is not None else _env_int("GUBER_TOPK", 256)
+        width = (width if width is not None
+                 else _env_int("GUBER_SKETCH_WIDTH", 4 * k))
+        self._mu = threading.Lock()  # guards sketch + counters
+        self.sketch = HeavyHitterSketch(k=k, width=width)
+        self.phases = PhaseLedger()
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_cap)
+        self._waves = 0
+        self._dropped = 0
+        self._pub_mu = threading.Lock()  # serializes gauge refreshes
+        self._published: Dict[str, float] = {}
+        self._last_publish = 0.0
+        self._closing = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="key-analytics")
+        self._thread.start()
+
+    # ---- taps (serving path; must stay O(copy) and non-blocking) -------
+
+    def tap_packed(self, khash, hits, status) -> bool:
+        """Columnar wave tap: copies the three columns NOW (the caller's
+        arrays may be pool-leased or shared result views) and enqueues.
+        Returns False when the queue was full (wave dropped)."""
+        item = ("cols",
+                np.array(khash, np.uint64, copy=True),
+                np.array(hits, np.int64, copy=True),
+                np.array(np.asarray(status) == 1, bool),
+                int(self._clock() * 1000))
+        return self._put(item)
+
+    def tap_reqs(self, reqs, resps) -> bool:
+        """Object-lane tap: the worker extracts names/hits/status (and
+        hashes the keys) off the serving path."""
+        if not reqs:
+            return True
+        return self._put(("reqs", list(reqs), list(resps),
+                          int(self._clock() * 1000)))
+
+    def _put(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            with self._mu:
+                self._dropped += 1
+            if self.metrics is not None:
+                self.metrics.analytics_dropped.inc()
+            return False
+        return True
+
+    # ---- phase attribution ---------------------------------------------
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        """One phase sample → histogram + /debug/phases ledger."""
+        seconds = max(seconds, 0.0)
+        self.phases.observe(phase, seconds)
+        m = self.metrics
+        if m is not None:
+            child = self._phase_hist.get(phase)
+            if child is None:  # benign race: labels() is idempotent
+                child = self._phase_hist[phase] = \
+                    m.phase_duration.labels(phase=phase)
+            child.observe(seconds)
+
+    # ---- worker ---------------------------------------------------------
+
+    def _run(self) -> None:
+        q = self._q
+        while True:
+            item = q.get()
+            cols: list = []
+            while True:
+                if item is None:
+                    self._fold_cols(cols)
+                    return
+                if isinstance(item, _Flush):
+                    self._fold_cols(cols)
+                    cols = []
+                    item.done.set()
+                elif item[0] == "cols":
+                    cols.append(item)
+                else:
+                    # object-lane (named) tap: fold queued columns
+                    # first so wave order is preserved
+                    self._fold_cols(cols)
+                    cols = []
+                    self._safe_apply(item)
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+            self._fold_cols(cols)
+            if not self._closing:
+                time.sleep(self.BATCH_INTERVAL_S)
+
+    def _fold_cols(self, cols: list) -> None:
+        """Everything the drain window collected folds in ONE sketch
+        update (one unique/sort/admission pass for the whole burst)."""
+        if not cols:
+            return
+        try:
+            if len(cols) == 1:
+                _, khash, hits, over, t_ms = cols[0]
+            else:
+                khash = np.concatenate([c[1] for c in cols])
+                hits = np.concatenate([c[2] for c in cols])
+                over = np.concatenate([c[3] for c in cols])
+                t_ms = cols[-1][4]
+            with self._mu:
+                self.sketch.update(khash, hits, over, t_ms)
+                self._waves += len(cols)
+            if self.metrics is not None:
+                self.metrics.analytics_waves.inc(len(cols))
+            self._maybe_publish()
+        except Exception:  # pragma: no cover - must never die
+            import logging
+
+            logging.getLogger("gubernator_tpu.analytics").exception(
+                "analytics fold")
+
+    def _safe_apply(self, item) -> None:
+        try:
+            self._apply(item)
+        except Exception:  # pragma: no cover - must never die
+            import logging
+
+            logging.getLogger("gubernator_tpu.analytics").exception(
+                "analytics tap apply")
+
+    def _apply(self, item) -> None:
+        _, reqs, resps, t_ms = item
+        from .hashing import hash_request_keys
+
+        khash = hash_request_keys([r.name for r in reqs],
+                                  [r.unique_key for r in reqs])
+        hits = np.fromiter((int(r.hits) for r in reqs), np.int64,
+                           len(reqs))
+        over = np.fromiter((int(r.status) == 1 for r in resps),
+                           bool, len(resps))
+        names = [f"{r.name}_{r.unique_key}" for r in reqs]
+        with self._mu:
+            self.sketch.update(khash, hits, over, t_ms, names=names)
+            self._waves += 1
+        if self.metrics is not None:
+            self.metrics.analytics_waves.inc()
+        self._maybe_publish()
+
+    def _maybe_publish(self) -> None:
+        now = time.monotonic()
+        if now - self._last_publish >= self.PUBLISH_INTERVAL_S:
+            self._last_publish = now
+            self._publish()
+
+    def republish(self) -> None:
+        """Scrape-time gauge refresh (daemon /metrics handler): the
+        label churn costs the scraper, never the analytics worker."""
+        self._last_publish = time.monotonic()
+        self._publish()
+
+    def _publish(self) -> None:
+        """Refresh gubernator_topkey_overlimit_total for the CURRENT
+        top-K only: labels of departed keys are removed first, so the
+        family's cardinality is bounded by K at every scrape — never
+        per-key labels over the whole key space."""
+        if self.metrics is None:
+            return
+        with self._mu:
+            top = self.sketch.topk()
+        fresh = {}
+        for e in top:
+            label = e["key"] or f"0x{e['khash']:016x}"
+            fresh[label] = float(e["over_limit"])
+        gauge = self.metrics.topkey_overlimit
+        with self._pub_mu:
+            for label in list(self._published):
+                if label not in fresh:
+                    try:
+                        gauge.remove(label)
+                    except KeyError:  # pragma: no cover - already gone
+                        pass
+            for label, val in fresh.items():
+                gauge.labels(key=label).set(val)
+            self._published = fresh
+
+    # ---- reporting ------------------------------------------------------
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until every tap enqueued so far has been applied (and
+        the gauge republished) — tests and snapshot callers."""
+        f = _Flush()
+        try:
+            self._q.put(f, timeout=timeout)
+        except queue.Full:
+            return False
+        ok = f.done.wait(timeout)
+        if ok:
+            self._publish()
+        return ok
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"k": self.sketch.k, "width": self.sketch.width,
+                    "waves_tapped": self._waves,
+                    "taps_dropped": self._dropped,
+                    "tracked_keys": len(self.sketch),
+                    "queue_depth": self._q.qsize()}
+
+    def topkeys_snapshot(self, limit: Optional[int] = None) -> dict:
+        """The ``GET /debug/topkeys`` document (owner resolution is the
+        daemon's job — it knows the ring)."""
+        with self._mu:
+            top = self.sketch.topk(limit)
+            bound = self.sketch.error_bound()
+            total = self.sketch.total_weight
+        out = self.stats()
+        out.update({"total_hits_observed": total,
+                    "admission_error_bound": bound,
+                    "keys": [dict(e, khash=f"0x{e['khash']:016x}")
+                             for e in top]})
+        return out
+
+    def phases_snapshot(self) -> dict:
+        return {"phases": self.phases.snapshot()}
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:  # drain enough to deliver the poison pill
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._q.put(None)
+        self._thread.join(timeout=5)

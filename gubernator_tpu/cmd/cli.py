@@ -20,11 +20,18 @@ import time
 import numpy as np
 
 
-def _debug_main(argv) -> int:
-    """``debug events``: fetch the daemon's flight-recorder ring from
-    GET /debug/events and print it (one line per event, or raw JSON)."""
+def _fetch_json(url: str, timeout: float):
     import urllib.request
 
+    with urllib.request.urlopen(url, timeout=timeout) as f:
+        return json.loads(f.read())
+
+
+def _debug_main(argv) -> int:
+    """``debug events``: fetch the daemon's flight-recorder ring from
+    GET /debug/events (kind/since-seq filtered SERVER-side) and print
+    it.  ``debug topkeys``: the heavy-hitter ledger from
+    GET /debug/topkeys."""
     ap = argparse.ArgumentParser(
         prog="guber-cli debug",
         description="gubernator-tpu debug introspection")
@@ -38,19 +45,44 @@ def _debug_main(argv) -> int:
                     help="only the newest N events")
     ev.add_argument("--kind", default="",
                     help="only events of this kind (e.g. wave_stalled)")
+    ev.add_argument("--since-seq", type=int, default=0,
+                    help="only events with seq > N (incremental polls)")
     ev.add_argument("--timeout", type=float, default=10.0)
     ev.add_argument("--json", action="store_true",
                     help="print the raw JSON document")
+    tk = sub.add_parser("topkeys",
+                        help="dump the daemon's heavy-hitter key "
+                             "ledger (/debug/topkeys)")
+    tk.add_argument("--url", default="http://localhost:1050",
+                    help="daemon HTTP base url (or a full "
+                         "/debug/topkeys url)")
+    tk.add_argument("--limit", type=int, default=0,
+                    help="only the heaviest N keys")
+    tk.add_argument("--timeout", type=float, default=10.0)
+    tk.add_argument("--json", action="store_true",
+                    help="print the raw JSON document")
     args = ap.parse_args(argv)
+    if args.what == "topkeys":
+        return _debug_topkeys(args)
 
     url = args.url
     if "/debug/events" not in url:
         url = url.rstrip("/") + "/debug/events"
+
+    def _q(param):
+        nonlocal url
+        url += ("&" if "?" in url else "?") + param
+
     if args.limit > 0:
-        url += ("&" if "?" in url else "?") + f"limit={args.limit}"
+        _q(f"limit={args.limit}")
+    if args.kind:
+        # server-side filter; the client-side pass below still applies
+        # (harmless, and keeps the flag working against older daemons)
+        _q(f"kind={args.kind}")
+    if args.since_seq > 0:
+        _q(f"since_seq={args.since_seq}")
     try:
-        with urllib.request.urlopen(url, timeout=args.timeout) as f:
-            body = json.loads(f.read())
+        body = _fetch_json(url, args.timeout)
     except Exception as e:  # noqa: BLE001
         print(f"fetch failed: {e!r}", file=sys.stderr)
         return 1
@@ -73,6 +105,38 @@ def _debug_main(argv) -> int:
         print(line)
     if not events:
         print("(no events)", file=sys.stderr)
+    return 0
+
+
+def _debug_topkeys(args) -> int:
+    url = args.url
+    if "/debug/topkeys" not in url:
+        url = url.rstrip("/") + "/debug/topkeys"
+    if args.limit > 0:
+        url += ("&" if "?" in url else "?") + f"limit={args.limit}"
+    try:
+        body = _fetch_json(url, args.timeout)
+    except Exception as e:  # noqa: BLE001
+        print(f"fetch failed: {e!r}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body))
+        return 0
+    print(f"top-{body.get('k')} of ~{body.get('total_hits_observed')} "
+          f"hits across {body.get('waves_tapped')} waves "
+          f"(width={body.get('width')}, "
+          f"admission_err<={body.get('admission_error_bound')}, "
+          f"dropped={body.get('taps_dropped')})")
+    keys = body.get("keys", [])
+    for e in keys:
+        name = e.get("key") or e.get("khash")
+        line = (f"{e.get('hits'):>12}  over={e.get('over_limit'):<8} "
+                f"err<={e.get('err'):<6} {name}")
+        if e.get("owner"):
+            line += f"  owner={e['owner']}"
+        print(line)
+    if not keys:
+        print("(no keys tracked)", file=sys.stderr)
     return 0
 
 

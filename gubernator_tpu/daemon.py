@@ -150,6 +150,11 @@ class Daemon:
         self.tls = setup_tls(cfg.tls)
         self._closed = False
         self.profiler = DeviceProfiler.from_env()
+        #: on-demand device profiling (GET /debug/profile?seconds=N):
+        #: at most ONE capture at a time — jax.profiler is process-
+        #: global, so a second start_trace would corrupt the first
+        self._prof_mu = threading.Lock()
+        self._runtime_prof: Optional[dict] = None
         self.instance: Optional[V1Instance] = None
         self.discovery = None
         self.http_server: Optional[ThreadingHTTPServer] = None
@@ -276,6 +281,12 @@ class Daemon:
                 parts = urlsplit(self.path)
                 path, q = parts.path, parse_qs(parts.query)
                 if path == "/metrics":
+                    ana = daemon.instance.analytics
+                    if ana is not None:
+                        # scrape-time top-K gauge refresh: the label
+                        # churn (≤ K removes + sets) costs the scraper,
+                        # never the serving loop or analytics worker
+                        ana.republish()
                     self._send(200, daemon.instance.metrics.render(),
                                "text/plain; version=0.0.4")
                 elif path in ("/v1/HealthCheck", "/healthz"):
@@ -302,14 +313,64 @@ class Daemon:
                     self._send(code, json.dumps(body).encode())
                 elif path == "/debug/events":
                     # flight recorder ring (telemetry.py), newest-last;
-                    # ?limit=N keeps only the newest N events
+                    # ?limit=N keeps only the newest N events; ?kind=K
+                    # and ?since_seq=S filter SERVER-side so a polling
+                    # CLI doesn't re-download the whole ring
                     try:
                         limit = int(q.get("limit", ["0"])[-1]) or None
                     except ValueError:
                         limit = None
+                    kind = q.get("kind", [""])[-1] or None
+                    try:
+                        since = int(q.get("since_seq", ["0"])[-1]) or None
+                    except ValueError:
+                        since = None
                     self._send(200, json.dumps({
                         "events": daemon.instance.recorder.events(
-                            limit=limit)}).encode())
+                            limit=limit, kind=kind,
+                            since_seq=since)}).encode())
+                elif path == "/debug/topkeys":
+                    # heavy-hitter ledger (analytics.py): the current
+                    # top-K keys with hits / over-limit / error bound /
+                    # last-seen, plus each key's ring owner when
+                    # hash-level routing is valid
+                    ana = daemon.instance.analytics
+                    if ana is None:
+                        self._send(404, json.dumps(
+                            {"error": "analytics disabled "
+                                      "(GUBER_ANALYTICS=0)"}).encode())
+                        return
+                    try:
+                        limit = int(q.get("limit", ["0"])[-1]) or None
+                    except ValueError:
+                        limit = None
+                    ana.flush(timeout=2.0)  # fold queued taps first
+                    snap = ana.topkeys_snapshot(limit)
+                    for e in snap["keys"]:
+                        e["owner"] = daemon.instance.owner_addr_by_khash(
+                            int(e["khash"], 16))
+                    self._send(200, json.dumps(snap).encode())
+                elif path == "/debug/phases":
+                    # per-phase latency attribution (analytics.py ›
+                    # PhaseLedger) + the wave-duration reference the
+                    # in-wave phases partition
+                    ana = daemon.instance.analytics
+                    if ana is None:
+                        self._send(404, json.dumps(
+                            {"error": "analytics disabled "
+                                      "(GUBER_ANALYTICS=0)"}).encode())
+                        return
+                    body = ana.phases_snapshot()
+                    tel = daemon.instance.dispatcher.telemetry_snapshot()
+                    body["waves"] = {
+                        k: tel.get(k) for k in
+                        ("waves", "wave_duration_p50_ms",
+                         "wave_duration_p99_ms", "queue_wait_p50_ms",
+                         "queue_wait_p99_ms")}
+                    self._send(200, json.dumps(body).encode())
+                elif path == "/debug/profile":
+                    code, body = daemon._handle_profile(q)
+                    self._send(code, json.dumps(body).encode())
                 else:
                     self._send(404, b'{"error":"not found"}')
 
@@ -341,6 +402,77 @@ class Daemon:
             target=self.http_server.serve_forever, daemon=True,
             name=f"http-{addr}")
         self._http_thread.start()
+
+    # ---- on-demand device profiling (GET /debug/profile) ----------------
+
+    #: hard cap on a runtime capture: profiling taxes the serving loop
+    #: and the trace grows with time — an unbounded capture left running
+    #: would eventually wedge the daemon's disk
+    PROFILE_MAX_SECONDS = 300.0
+
+    def _handle_profile(self, q: dict):
+        """``?seconds=N`` starts a DeviceProfiler capture for N seconds
+        into a fresh directory (409 while any capture — runtime or the
+        GUBER_PROFILE_DIR startup one — is active); without ``seconds``
+        it reports capture status.  Returns (http_code, json_body)."""
+        from .tracing import DeviceProfiler
+
+        raw = q.get("seconds", [""])[-1]
+        with self._prof_mu:
+            active = (self._runtime_prof is not None
+                      and not self._runtime_prof["done"].is_set())
+            if not raw:
+                body = {"active": active}
+                if self._runtime_prof is not None:
+                    body.update({
+                        "dir": self._runtime_prof["dir"],
+                        "seconds": self._runtime_prof["seconds"]})
+                elif self.profiler is not None:
+                    body.update({"active": True,
+                                 "dir": self.profiler.log_dir,
+                                 "startup_env": True})
+                return 200, body
+            try:
+                seconds = float(raw)
+            except ValueError:
+                return 400, {"error": f"invalid seconds={raw!r}"}
+            if not (0 < seconds <= self.PROFILE_MAX_SECONDS):
+                return 400, {"error": f"seconds must be in (0, "
+                                      f"{self.PROFILE_MAX_SECONDS:.0f}]"}
+            if active or self.profiler is not None:
+                # one capture at a time: jax.profiler is process-global
+                return 409, {"error": "a profile capture is already "
+                                      "active"}
+            import tempfile
+
+            log_dir = tempfile.mkdtemp(prefix="guber_profile_")
+            try:
+                prof = DeviceProfiler(log_dir)
+            except Exception as e:  # noqa: BLE001 - surfaced to caller
+                return 500, {"error": f"profiler start failed: "
+                                      f"{exc_text(e)}"}
+            done = threading.Event()
+            state = {"profiler": prof, "dir": log_dir,
+                     "seconds": seconds, "done": done}
+            self._runtime_prof = state
+        self.instance.recorder.record("profile_start", dir=log_dir,
+                                      seconds=seconds)
+
+        def _stop_later():
+            done.wait(seconds)  # close() can cut the capture short
+            try:
+                prof.stop()
+            finally:
+                done.set()
+                self.instance.recorder.record("profile_stop",
+                                              dir=log_dir)
+
+        t = threading.Thread(target=_stop_later, daemon=True,
+                             name="debug-profile-stop")
+        state["thread"] = t
+        t.start()
+        return 200, {"profiling": True, "dir": log_dir,
+                     "seconds": seconds}
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -376,6 +508,15 @@ class Daemon:
             self.instance.close()
         if self.profiler is not None:
             self.profiler.stop()
+        with self._prof_mu:
+            rp = self._runtime_prof
+        if rp is not None and not rp["done"].is_set():
+            # cut a running on-demand capture short; its stop thread
+            # owns the actual profiler.stop() (single stop path)
+            rp["done"].set()
+            t = rp.get("thread")
+            if t is not None:
+                t.join(timeout=5)
 
 
 def spawn_daemon(cfg: DaemonConfig, mesh=None, engine=None) -> Daemon:

@@ -132,8 +132,16 @@ class Dispatcher:
     def __init__(self, engine, max_wave: int = 8192,
                  max_delay_ms: float = 0.2,
                  lock: Optional[threading.Lock] = None,
-                 metrics=None, recorder=None, clock=time.monotonic):
+                 metrics=None, recorder=None, clock=time.monotonic,
+                 analytics=None):
         self.engine = engine
+        #: key-level analytics subsystem (analytics.py › KeyAnalytics,
+        #: optional): resolved waves tap their khash/hits/status
+        #: columns into its worker queue AFTER the wave ends — strictly
+        #: off the caller's critical path — and per-phase durations
+        #: feed its ledger.  None (bare dispatchers) costs nothing.
+        self.analytics = analytics
+        self._phase_hist: dict = {}  # phase → cached histogram child
         self.max_wave = max_wave
         # coalescing window: how long the worker waits for more jobs
         # after the first before launching the wave.  GUBER_COALESCE_US
@@ -302,8 +310,10 @@ class Dispatcher:
         try:
             wid = self._wave_begin(kind, nreq=nreq)
             try:
+                self._wave_mark(wid, "pack")
                 with self._engine_lock:
                     out = fn()
+                self._wave_mark(wid, "device")
             except Exception as e:  # noqa: BLE001 - recorded, re-raised
                 self._wave_end(wid, error=e)
                 raise
@@ -326,12 +336,15 @@ class Dispatcher:
             try:
                 wid = self._wave_begin("inline", nreq=len(reqs))
                 try:
+                    self._wave_mark(wid, "pack")
                     with self._engine_lock:
                         out = self.engine.check_batch(list(reqs), now_ms)
+                    self._wave_mark(wid, "device")
                 except Exception as e:  # noqa: BLE001 - recorded, re-raised
                     self._wave_end(wid, error=e)
                     raise
                 self._wave_end(wid)
+                self._tap_reqs(reqs, out)
                 return out
             finally:
                 self._inline_mu.release()
@@ -361,13 +374,16 @@ class Dispatcher:
             try:
                 wid = self._wave_begin("inline_packed", nreq=len(khash))
                 try:
+                    self._wave_mark(wid, "pack")
                     with self._engine_lock:
                         out = self.engine.check_packed(batch, khash,
                                                        now_ms)
+                    self._wave_mark(wid, "device")
                 except Exception as e:  # noqa: BLE001 - recorded, re-raised
                     self._wave_end(wid, error=e)
                     raise
                 self._wave_end(wid)
+                self._tap_packed(khash, batch.hits, out[0])
                 return ResultView(out, 0, len(khash))
             finally:
                 self._inline_mu.release()
@@ -422,7 +438,7 @@ class Dispatcher:
             wid = self._wave_seq
             self._inflight[wid] = {"t0": t0, "kind": kind, "size": nreq,
                                    "trace": trace, "stalled": False,
-                                   "slot": slot}
+                                   "slot": slot, "marks": []}
             self._recent_sizes.append(nreq)
             self._recent_waits.extend(waits)
         if self.metrics is not None:
@@ -430,6 +446,8 @@ class Dispatcher:
             for w in waits:
                 self.metrics.wave_queue_wait.observe(w)
             self.metrics.waves_in_flight.inc()
+        for w in waits:
+            self._obs_phase("queue_wait", w)
         if self.recorder is not None:
             ev = {"trace": trace, "wave": wid, "wave_kind": kind,
                   "size": nreq, "jobs": len(jobs) if jobs else 1}
@@ -439,6 +457,55 @@ class Dispatcher:
                 ev["slot"] = slot
             self.recorder.record("wave_launched", **ev)
         return wid
+
+    # ---- per-phase attribution (ISSUE 4) --------------------------------
+    #
+    # Each wave's duration partitions into named segments: a mark with
+    # name N stamps the END of segment N; the tail segment (last mark →
+    # wave end) is "resolve" (future resolution / view construction).
+    # Every execution path marks "pack" (host-side packing/concat up to
+    # the engine call, incl. pipelined launch work) and "device" (the
+    # engine/sync call), so pack + device + resolve == wave_duration up
+    # to float rounding — asserted in tests/test_telemetry.py.
+
+    def _wave_mark(self, wid: int, name: str) -> None:
+        t = self._clock()
+        with self._tel_mu:
+            info = self._inflight.get(wid)
+            if info is not None:
+                info["marks"].append((name, t))
+
+    def _obs_phase(self, phase: str, seconds: float) -> None:
+        """One phase sample → histogram (+ the analytics ledger when
+        attached; KeyAnalytics.observe_phase already feeds the same
+        histogram, so don't double-observe)."""
+        ana = self.analytics
+        if ana is not None:
+            ana.observe_phase(phase, seconds)
+        elif self.metrics is not None:
+            child = self._phase_hist.get(phase)
+            if child is None:  # benign race: labels() is idempotent
+                child = self._phase_hist[phase] = \
+                    self.metrics.phase_duration.labels(phase=phase)
+            child.observe(max(seconds, 0.0))
+
+    def _tap_packed(self, khash, hits, status) -> None:
+        """Post-wave columnar tap (None-guarded, never raises into the
+        serving path)."""
+        ana = self.analytics
+        if ana is not None:
+            try:
+                ana.tap_packed(khash, hits, status)
+            except Exception:  # pragma: no cover - analytics only
+                log.exception("analytics tap")
+
+    def _tap_reqs(self, reqs, resps) -> None:
+        ana = self.analytics
+        if ana is not None:
+            try:
+                ana.tap_reqs(reqs, resps)
+            except Exception:  # pragma: no cover - analytics only
+                log.exception("analytics tap")
 
     def _wave_end(self, wid: int, error: Optional[BaseException] = None
                   ) -> None:
@@ -457,6 +524,20 @@ class Dispatcher:
             was_stalled = info["stalled"]
             any_stalled = any(i["stalled"]
                               for i in self._inflight.values())
+        # segment the wave into its phases (marks stamp segment ENDS;
+        # the tail is "resolve") and observe each — off the _tel_mu
+        # lock, still before any caller resumes from this wave
+        phases = None
+        marks = info.get("marks")
+        if marks:
+            phases = {}
+            prev = info["t0"]
+            for name, tm in marks:
+                phases[name] = max(tm - prev, 0.0)
+                prev = tm
+            phases["resolve"] = max(t1 - prev, 0.0)
+            for name, secs in phases.items():
+                self._obs_phase(name, secs)
         if self.metrics is not None:
             self.metrics.wave_duration.observe(dur)
             self.metrics.waves_in_flight.dec()
@@ -477,6 +558,10 @@ class Dispatcher:
                   "duration_ms": round(dur * 1000, 3)}
             if info.get("slot") is not None:
                 ev["slot"] = info["slot"]
+            if phases is not None:
+                # per-phase breakdown in ms; sums to duration_ms
+                ev["phases"] = {k: round(v * 1000, 3)
+                                for k, v in phases.items()}
             if error is not None:
                 self.recorder.record("wave_error", error=exc_text(error),
                                      **ev)
@@ -586,6 +671,11 @@ class Dispatcher:
             "buffer_pool": (self.engine.wave_pool.stats()
                             if hasattr(self.engine, "wave_pool")
                             else None),
+            # heavy-hitter tap shape (ISSUE 4): queue depth + drop
+            # count — a saturated analytics worker sheds waves, it
+            # never backs the serving path up
+            "analytics": (self.analytics.stats()
+                          if self.analytics is not None else None),
         }
 
     def telemetry_snapshot(self) -> dict:
@@ -760,7 +850,10 @@ class Dispatcher:
             now = max(j.now_ms for j in jobs)
             with self._engine_lock:
                 token = self.engine.launch_packed(batch, khash, now)
-            return (jobs, token, wid)
+            # the launch's host-side routing/fill IS pack work; device
+            # time runs from here until sync_packed returns
+            self._wave_mark(wid, "pack")
+            return (jobs, token, wid, batch, khash)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
             self._wave_end(wid, error=e)
             for j in jobs:
@@ -768,10 +861,11 @@ class Dispatcher:
                     j.future.set_exception(e)
             return None
 
-    def _sync_and_resolve(self, jobs, token, wid) -> None:
+    def _sync_and_resolve(self, jobs, token, wid, batch, khash) -> None:
         try:
             cols = self.engine.sync_packed(
                 token, engine_lock=self._engine_lock)
+            self._wave_mark(wid, "device")
             a = 0
             for j in jobs:
                 b = a + len(j.khash)
@@ -780,6 +874,7 @@ class Dispatcher:
                 j.future.set_result(ResultView(cols, a, b))
                 a = b
             self._wave_end(wid)
+            self._tap_packed(khash, batch.hits, cols[0])
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
             self._wave_end(wid, error=e)
             for j in jobs:
@@ -799,17 +894,18 @@ class Dispatcher:
 
         wid = self._wave_begin("merged", wave)
         try:
-            self._run_merged_wave_inner(
+            tap = self._run_merged_wave_inner(
                 wave, np, pack_requests, hash_request_keys,
-                responses_from_columns)
+                responses_from_columns, wid)
         except Exception as e:  # noqa: BLE001 - caller fails the futures
             self._wave_end(wid, error=e)
             raise
         self._wave_end(wid)
+        self._tap_packed(*tap)
 
     def _run_merged_wave_inner(self, wave, np, pack_requests,
                                hash_request_keys,
-                               responses_from_columns) -> None:
+                               responses_from_columns, wid) -> tuple:
         parts = []  # (job, batch, khash, errs or None)
         for j in wave:
             if isinstance(j, _PackedJob):
@@ -822,9 +918,11 @@ class Dispatcher:
                 parts.append((j, b, kh, errs))
         batch, khash = _concat_columns([(p[1], p[2]) for p in parts])
         now = max(j.now_ms for j in wave)
+        self._wave_mark(wid, "pack")
         with self._engine_lock:
             st, lim, rem, rst, full = self.engine.check_packed(
                 batch, khash, now)
+        self._wave_mark(wid, "device")
         a = 0
         cols = (st, lim, rem, rst, full)
         for j, _, kh, errs in parts:
@@ -836,6 +934,7 @@ class Dispatcher:
                     (st[a:b_], lim[a:b_], rem[a:b_], rst[a:b_],
                      full[a:b_]), errs))
             a = b_
+        return (khash, batch.hits, st)
 
     def _run_list_jobs(self, jobs, now) -> None:
         if not jobs:
@@ -848,11 +947,14 @@ class Dispatcher:
             slices.append((j, start, len(merged)))
         wid = self._wave_begin("list", jobs)
         try:
+            self._wave_mark(wid, "pack")
             with self._engine_lock:
                 resps = self.engine.check_batch(merged, now)
+            self._wave_mark(wid, "device")
             for j, a, b in slices:
                 j.future.set_result(resps[a:b])
             self._wave_end(wid)
+            self._tap_reqs(merged, resps)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
             self._wave_end(wid, error=e)
             for j, _, _ in slices:
@@ -874,14 +976,17 @@ class Dispatcher:
             # scalar now only backstops sweeps/padding; requests use
             # their own now column.  max() keeps sweep time monotonic.
             now = max(j.now_ms for j in jobs)
+            self._wave_mark(wid, "pack")
             with self._engine_lock:
                 cols = self.engine.check_packed(batch, khash, now)
+            self._wave_mark(wid, "device")
             a = 0
             for j in jobs:
                 b = a + len(j.khash)
                 j.future.set_result(ResultView(cols, a, b))
                 a = b
             self._wave_end(wid)
+            self._tap_packed(khash, batch.hits, cols[0])
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
             self._wave_end(wid, error=e)
             for j in jobs:

@@ -118,13 +118,23 @@ class V1Instance:
         self._engine_mu = threading.Lock()
         from .dispatcher import Dispatcher
 
+        # Key-level analytics (ISSUE 4, analytics.py): heavy-hitter
+        # ledger + per-phase latency attribution, fed off the hot path
+        # from resolved waves' columns.  GUBER_ANALYTICS=0 disables the
+        # whole subsystem (GUBER_TOPK / GUBER_SKETCH_WIDTH tune it).
+        analytics = None
+        if os.environ.get("GUBER_ANALYTICS", "1") != "0":
+            from .analytics import KeyAnalytics
+
+            analytics = KeyAnalytics(metrics=self.metrics)
         # Cross-request coalescing: concurrent handler threads share
         # device launches instead of serializing on the engine lock
         # (the worker-pool analog, see dispatcher.py).  Wave telemetry
         # lands on this instance's registry + recorder.
         self.dispatcher = Dispatcher(engine, lock=self._engine_mu,
                                      metrics=self.metrics,
-                                     recorder=self.recorder)
+                                     recorder=self.recorder,
+                                     analytics=analytics)
         # wave-buffer pool counters (hit/miss/leak) land on this
         # instance's registry; the pool lives engine-side (lease scope
         # is the engine's fill→launch window)
@@ -201,7 +211,8 @@ class V1Instance:
                 else:
                     picker.add(PeerClient(info, self.config.behaviors,
                                           tls_creds=self._peer_tls,
-                                          metrics=self.metrics))
+                                          metrics=self.metrics,
+                                          analytics=self.analytics))
             self._picker = picker
         for departed in old.values():
             threading.Thread(target=departed.shutdown, daemon=True).start()
@@ -348,6 +359,36 @@ class V1Instance:
             self.recorder.record("handover", rows=sent,
                                  peers=len(moved))
 
+    @property
+    def analytics(self):
+        """The key-analytics subsystem (None when disabled).  Lives on
+        the dispatcher so bench A/B detaches ONE reference and every
+        tap — dispatcher waves and fused instance lanes — goes dark."""
+        return self.dispatcher.analytics
+
+    def _obs_phase(self, phase: str, seconds: float) -> None:
+        """Phase attribution outside the dispatcher's waves (wire
+        ingest, response build); no-op when analytics is off."""
+        ana = self.dispatcher.analytics
+        if ana is not None:
+            ana.observe_phase(phase, seconds)
+
+    def owner_addr_by_khash(self, khash: int) -> Optional[str]:
+        """Owner peer address for a MIXED table key hash (the heavy-
+        hitter ledger's key space) — /debug/topkeys' owner column.
+        None when solo, on a custom picker hash (hash-level routing
+        would be wrong there), or for an emptied ring."""
+        with self._peer_mu:
+            picker = self._picker
+            if not picker.peers():
+                return None
+        if not self._uses_default_hash(picker):
+            return None
+        try:
+            return picker.get_by_hash(int(khash)).info.grpc_address
+        except RuntimeError:  # ring emptied concurrently
+            return None
+
     def peers(self) -> List[PeerClient]:
         with self._peer_mu:
             return self._picker.peers()
@@ -458,7 +499,10 @@ class V1Instance:
                 out = self._wire_client_fused(data, now_ms)
                 if out is not None:
                     return out
+            t_ing = time.perf_counter()
             parsed = _wire_native.parse_get_rate_limits(data)
+            if parsed is not None:
+                self._obs_phase("ingest", time.perf_counter() - t_ing)
             if parsed is not None:
                 is_global = bool(parsed["behavior_or"]
                                  & int(Behavior.GLOBAL))
@@ -565,9 +609,11 @@ class V1Instance:
         if prepack is None:
             return None
         now = clock_ms() if now_ms is None else now_ms
+        t_ing = time.perf_counter()
         pre = prepack(data, now)
         if pre is None:
             return None
+        self._obs_phase("ingest", time.perf_counter() - t_ing)
         if pre.behavior_or & int(self._FUSED_EXCLUDED):
             # GLOBAL rides the hot-set flow, MULTI_REGION queues async
             # replication — both need the parsed columns; the classic
@@ -604,9 +650,11 @@ class V1Instance:
         if prepack is None:
             return None
         now = clock_ms() if now_ms is None else now_ms
+        t_ing = time.perf_counter()
         pre = prepack(data, now)
         if pre is None:
             return None
+        self._obs_phase("ingest", time.perf_counter() - t_ing)
         if pre.behavior_or & int(self._FUSED_EXCLUDED):
             pre.lease.release()
             return None
@@ -630,6 +678,12 @@ class V1Instance:
         disp = self.dispatcher
         eng = self.engine
         n = pre.n
+        ana = disp.analytics
+        # the hits column lives in the LEASED matrices, which the next
+        # wave reuses once check_prepacked releases them — snapshot it
+        # up front when the tap will need it (khash is lease-free)
+        hits_tap = (np.array(pre.lease.a64[1][:n])
+                    if ana is not None else None)
         out = disp.run_inline_wave(
             "inline_wire", n, lambda: eng.check_prepacked(pre, now))
         if out is not disp._BUSY:
@@ -641,8 +695,13 @@ class V1Instance:
                 errors = [None] * n
                 for i in np.nonzero(full)[0]:
                     errors[int(i)] = "rate limit table full"
-            return _wire_native.build_responses_from_columns(
+            t_b = time.perf_counter()
+            resp = _wire_native.build_responses_from_columns(
                 (status, lim, rem, rst, full), 0, n, errors)
+            self._obs_phase("build", time.perf_counter() - t_b)
+            if ana is not None:
+                disp._tap_packed(pre.khash, hits_tap, status)
+            return resp
         # contended: copy the rows out of the lease (the queued job
         # outlives it) and coalesce with the other callers' waves
         from .core.batch import RequestBatch
@@ -666,8 +725,11 @@ class V1Instance:
             errors = [None] * n
             for i in np.nonzero(full)[0]:
                 errors[int(i)] = "rate limit table full"
-        return _wire_native.build_responses_from_columns(
+        t_b = time.perf_counter()
+        resp = _wire_native.build_responses_from_columns(
             view.cols, view.lo, view.hi, errors)
+        self._obs_phase("build", time.perf_counter() - t_b)
+        return resp
 
     def get_peer_rate_limits_wire(self, data: bytes,
                                   now_ms: Optional[int] = None) -> bytes:
@@ -687,7 +749,10 @@ class V1Instance:
             out = self._wire_peer_fused(data, now_ms)
             if out is not None:
                 return out
+            t_ing = time.perf_counter()
             parsed = _wire_native.parse_get_rate_limits(data)
+            if parsed is not None:
+                self._obs_phase("ingest", time.perf_counter() - t_ing)
         if parsed is None:
             from google.protobuf.message import DecodeError
 
@@ -925,8 +990,11 @@ class V1Instance:
             for i in np.nonzero(full)[0]:
                 if errors[int(i)] is None:
                     errors[int(i)] = "rate limit table full"
-        return _wire_native.build_responses_from_columns(
+        t_b = time.perf_counter()
+        resp = _wire_native.build_responses_from_columns(
             view.cols, view.lo, view.hi, errors)
+        self._obs_phase("build", time.perf_counter() - t_b)
+        return resp
 
     def _wire_check_columns(self, parsed: dict, now: int) -> bytes:
         """Parsed wire columns → device step → serialized responses
@@ -1616,6 +1684,8 @@ class V1Instance:
         if self._hot_sync_loop is not None:
             self._hot_sync_loop.close()
         self.dispatcher.close()
+        if self.dispatcher.analytics is not None:
+            self.dispatcher.analytics.close()
         self._save_to_loader()
         for p in self.peers():
             p.shutdown()

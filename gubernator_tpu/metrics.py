@@ -191,6 +191,33 @@ class Metrics:
             "gubernator_peer_circuit_state",
             "1 while a peer's circuit is open (sends fail fast)",
             ["peer_addr"], registry=r)
+        # Key-level analytics (ISSUE 4): per-phase latency attribution
+        # + the bounded heavy-hitter ledger's export surface.  The
+        # topkey gauge is label-bounded BY CONSTRUCTION: analytics.py ›
+        # KeyAnalytics._publish removes departed keys' labels before
+        # setting the current top-K, so cardinality never exceeds
+        # GUBER_TOPK — per-key labels over the whole key space are
+        # exactly what a million-key deployment must never export.
+        self.phase_duration = Histogram(
+            "gubernator_phase_duration",
+            "request time attributed per serving phase (s): ingest, "
+            "pack, queue_wait, device, resolve, build, peer_flush — "
+            "pack+device+resolve partition wave_duration",
+            ["phase"], buckets=_BUCKETS, registry=r)
+        self.topkey_overlimit = Gauge(
+            "gubernator_topkey_overlimit_total",
+            "OVER_LIMIT decisions observed for each CURRENT top-K key "
+            "while tracked (bounded labels: departed keys are removed)",
+            ["key"], registry=r)
+        self.analytics_waves = Counter(
+            "gubernator_analytics_waves_tapped",
+            "resolved waves folded into the heavy-hitter sketch",
+            registry=r)
+        self.analytics_dropped = Counter(
+            "gubernator_analytics_tap_dropped",
+            "wave taps dropped because the analytics queue was full "
+            "(analytics never applies backpressure to serving)",
+            registry=r)
 
     @contextmanager
     def time_func(self, name: str):

@@ -261,10 +261,13 @@ class _SendLane:
                  err: Optional[BaseException] = None) -> None:
         client = self.client
         m = client._metrics
+        dt = time.perf_counter() - t0
         if m is not None:
             m.batch_send_duration.labels(
-                peer_addr=client.info.grpc_address).observe(
-                    time.perf_counter() - t0)
+                peer_addr=client.info.grpc_address).observe(dt)
+        if client._analytics is not None:
+            # the forward hop's share of a request's wall time
+            client._analytics.observe_phase("peer_flush", dt)
         if err is not None:
             if (attempt < self.retries and not self._closing
                     and not client._circuit_blocked()):
@@ -363,11 +366,14 @@ class PeerClient:
 
     def __init__(self, info: PeerInfo, behaviors: BehaviorConfig,
                  tls_creds: Optional[grpc.ChannelCredentials] = None,
-                 metrics=None):
+                 metrics=None, analytics=None):
         self.info = info
         self.behaviors = behaviors
         self._tls = tls_creds
         self._metrics = metrics
+        #: optional KeyAnalytics: flush round-trips feed the
+        #: "peer_flush" phase of the latency ledger (ISSUE 4)
+        self._analytics = analytics
         self._channel: Optional[grpc.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
         self._raw_calls: dict = {}  # method → bytes-lane call handle
@@ -651,10 +657,12 @@ class PeerClient:
                 if not fut.done():
                     fut.set_exception(e)
         finally:
+            dt = time.perf_counter() - t0
             if self._metrics is not None:
                 self._metrics.batch_send_duration.labels(
-                    peer_addr=self.info.grpc_address).observe(
-                        time.perf_counter() - t0)
+                    peer_addr=self.info.grpc_address).observe(dt)
+            if self._analytics is not None:
+                self._analytics.observe_phase("peer_flush", dt)
 
     # ---- lifecycle -----------------------------------------------------
 

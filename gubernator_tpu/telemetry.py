@@ -61,24 +61,45 @@ class FlightRecorder:
         ev = {"kind": kind, "t_ms": int(self._clock() * 1000),
               "trace": trace}
         for k, v in fields.items():
-            if v is not None and not isinstance(v, (str, int, float, bool)):
-                v = repr(v)
-            ev[k] = v
+            ev[k] = self._coerce(v)
         with self._mu:
             self._seq += 1
             ev["seq"] = self._seq
             self._ring.append(ev)
         return ev
 
+    @classmethod
+    def _coerce(cls, v):
+        """JSON-safe coercion: primitives pass, one-level dicts keep
+        their structure (the wave_completed ``phases`` block must stay
+        queryable, not a repr string), everything else reprs."""
+        if v is None or isinstance(v, (str, int, float, bool)):
+            return v
+        if isinstance(v, dict):
+            return {str(k): (vv if vv is None
+                             or isinstance(vv, (str, int, float, bool))
+                             else repr(vv))
+                    for k, vv in v.items()}
+        return repr(v)
+
     def record_error(self, kind: str, e: BaseException, **fields) -> dict:
         """``record`` with the exception's non-empty text in ``error``."""
         return self.record(kind, error=exc_text(e), **fields)
 
-    def events(self, limit: Optional[int] = None) -> List[dict]:
-        """Chronological snapshot (oldest first); ``limit`` keeps only
-        the newest N."""
+    def events(self, limit: Optional[int] = None,
+               kind: Optional[str] = None,
+               since_seq: Optional[int] = None) -> List[dict]:
+        """Chronological snapshot (oldest first).  ``kind`` keeps only
+        events of that kind and ``since_seq`` only events with
+        ``seq > since_seq`` (both server-side, so a CLI polling for
+        stalls doesn't re-download the whole ring); ``limit`` then
+        keeps the newest N."""
         with self._mu:
             out = list(self._ring)
+        if kind:
+            out = [e for e in out if e.get("kind") == kind]
+        if since_seq is not None:
+            out = [e for e in out if e.get("seq", 0) > since_seq]
         if limit is not None and limit >= 0:
             out = out[len(out) - min(limit, len(out)):]
         return out

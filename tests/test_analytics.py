@@ -1,0 +1,383 @@
+"""Key-level analytics (ISSUE 4): Space-Saving sketch accuracy against
+an exact-count oracle (≤ K distinct keys → exact), the documented error
+bound on a skewed Zipf workload (≥ 10× K keys), bounded metric label
+cardinality, the never-block tap queue, and the live /debug endpoints
+(topkeys / phases / profile) on a real daemon."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.analytics import (HeavyHitterSketch, KeyAnalytics,
+                                      PhaseLedger)
+from gubernator_tpu.metrics import Metrics
+
+# ---- sketch accuracy ----------------------------------------------------
+
+
+def _fold_stream(sketch, khashes, hits=None, over=None, wave=500):
+    """Feed a key stream through the sketch in wave-sized column
+    chunks, the way the dispatcher taps it."""
+    khashes = np.asarray(khashes, np.uint64)
+    n = len(khashes)
+    hits = (np.ones(n, np.int64) if hits is None
+            else np.asarray(hits, np.int64))
+    over = (np.zeros(n, bool) if over is None
+            else np.asarray(over, bool))
+    for a in range(0, n, wave):
+        b = min(a + wave, n)
+        sketch.update(khashes[a:b], hits[a:b], over[a:b], t_ms=123)
+
+
+def test_exact_oracle_when_domain_fits_k():
+    """ISSUE 4 acceptance: on a workload with ≤ K distinct keys the
+    ledger IS an exact counter — hits and over-limit tallies match the
+    oracle and every error bound is 0."""
+    rng = np.random.default_rng(7)
+    k = 16
+    sk = HeavyHitterSketch(k=k, width=4 * k)
+    keys = rng.integers(1, k + 1, size=5000).astype(np.uint64)
+    hits = rng.integers(1, 5, size=5000).astype(np.int64)
+    over = rng.random(5000) < 0.25
+    _fold_stream(sk, keys, hits, over)
+
+    oracle_hits = Counter()
+    oracle_over = Counter()
+    for kh, h, o in zip(keys, hits, over):
+        oracle_hits[int(kh)] += int(h)
+        oracle_over[int(kh)] += int(o)
+
+    top = sk.topk()
+    assert len(top) == len(oracle_hits)
+    for e in top:
+        assert e["err"] == 0
+        assert e["hits"] == oracle_hits[e["khash"]]
+        assert e["over_limit"] == oracle_over[e["khash"]]
+    # ranked by true count
+    assert [e["hits"] for e in top] == sorted(
+        (e["hits"] for e in top), reverse=True)
+    assert sk.error_bound() == 0
+    assert sk.total_weight == int(hits.sum())
+
+
+def test_zipf_workload_respects_documented_error_bound():
+    """ISSUE 4 acceptance: on a Zipf-skewed stream over ≥ 10× K keys,
+    every reported count obeys the Space-Saving guarantee
+    ``true <= reported <= true + err`` with
+    ``err <= total_weight / width``, and every key heavier than
+    total/width is tracked (the guaranteed-heavy-hitter property)."""
+    rng = np.random.default_rng(11)
+    k, width = 16, 64
+    sk = HeavyHitterSketch(k=k, width=width)
+    domain = 10 * k
+    # zipf(1.3) clipped to the domain: a realistic hot-key skew
+    keys = (rng.zipf(1.3, size=40_000) % domain + 1).astype(np.uint64)
+    _fold_stream(sk, keys)
+
+    truth = Counter(int(x) for x in keys)
+    total = len(keys)
+    assert sk.total_weight == total
+    bound = total / width
+    assert sk.error_bound() <= bound
+
+    top = sk.topk()
+    assert len(top) == k
+    for e in top:
+        true = truth[e["khash"]]
+        assert e["hits"] >= true, "Space-Saving must never undercount"
+        assert e["hits"] - true <= e["err"], \
+            f"overestimate {e['hits'] - true} exceeds its err {e['err']}"
+        assert e["err"] <= bound
+    # guaranteed heavy hitters: every key with true count > total/width
+    # is tracked (its counter can never have been the eviction minimum)
+    tracked = {e["khash"] for e in sk.topk(width)}
+    for kh, c in truth.items():
+        if c > bound:
+            assert kh in tracked, f"guaranteed heavy hitter {kh} evicted"
+
+
+def test_eviction_inherits_count_but_not_overlimit():
+    sk = HeavyHitterSketch(k=2, width=2)
+    sk.update(np.array([1, 2], np.uint64), np.array([5, 3], np.int64),
+              np.array([1, 1], bool), t_ms=1)
+    # key 3 evicts the minimum (key 2, count 3): inherits count as err,
+    # but NOT the old key's over-limit tally
+    sk.update(np.array([3], np.uint64), np.array([2], np.int64),
+              np.array([1], bool), t_ms=2)
+    by_kh = {e["khash"]: e for e in sk.topk()}
+    assert set(by_kh) == {1, 3}
+    assert by_kh[3]["hits"] == 5 and by_kh[3]["err"] == 3
+    assert by_kh[3]["over_limit"] == 1  # its own, not key 2's
+
+
+def test_zero_hit_status_queries_still_register_presence():
+    sk = HeavyHitterSketch(k=4)
+    sk.update(np.array([9], np.uint64), np.array([0], np.int64),
+              np.array([0], bool), t_ms=1)
+    assert sk.topk()[0]["hits"] == 1  # clamped weight >= 1
+
+
+# ---- phase ledger -------------------------------------------------------
+
+
+def test_phase_ledger_snapshot_percentiles():
+    led = PhaseLedger()
+    for ms in (1, 2, 3, 4, 100):
+        led.observe("device", ms / 1e3)
+    snap = led.snapshot()["device"]
+    assert snap["count"] == 5
+    assert snap["total_ms"] == pytest.approx(110.0)
+    assert snap["p50_ms"] == pytest.approx(3.0)
+    assert snap["max_ms"] == pytest.approx(100.0)
+
+
+# ---- KeyAnalytics: taps, worker, publish bounds -------------------------
+
+
+def test_tap_worker_folds_columns_and_recovers_names():
+    ka = KeyAnalytics(metrics=None, k=8, width=32)
+    try:
+        from gubernator_tpu.hashing import hash_request_keys
+        from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+        reqs = [RateLimitRequest(name="ana", unique_key="hot", hits=3,
+                                 limit=10, duration=60_000)]
+        resps = [RateLimitResponse(status=1)]
+        assert ka.tap_reqs(reqs, resps)
+        # the same key later goes hot through a columnar wire tap that
+        # only knows the hash — the name side-table must resolve it
+        kh = hash_request_keys(["ana"], ["hot"])
+        assert ka.tap_packed(np.repeat(kh, 4), np.full(4, 2, np.int64),
+                             np.array([1, 0, 0, 1]))
+        assert ka.flush(timeout=10)
+        snap = ka.topkeys_snapshot()
+        assert snap["waves_tapped"] == 2
+        (e,) = snap["keys"]
+        assert e["key"] == "ana_hot"
+        assert e["hits"] == 3 + 8
+        assert e["over_limit"] == 1 + 2
+        assert e["khash"] == f"0x{int(kh[0]):016x}"
+    finally:
+        ka.close()
+
+
+def test_full_queue_drops_wave_without_blocking_caller():
+    """Analytics must shed load, never backpressure serving: with the
+    worker wedged and the queue full, a tap returns False fast."""
+    ka = KeyAnalytics(metrics=None, k=4, queue_cap=1)
+    gate = threading.Event()
+    applied = threading.Event()
+    orig_fold = ka._fold_cols
+
+    def stuck(cols):
+        if cols:
+            applied.set()
+            assert gate.wait(timeout=30)
+        orig_fold(cols)
+
+    ka._fold_cols = stuck
+    try:
+        kh = np.array([1], np.uint64)
+        one = np.array([1], np.int64)
+        assert ka.tap_packed(kh, one, one)  # worker picks this up...
+        assert applied.wait(timeout=10)     # ...and wedges in _apply
+        assert ka.tap_packed(kh, one, one)  # fills the 1-slot queue
+        t0 = time.perf_counter()
+        dropped = [ka.tap_packed(kh, one, one) for _ in range(50)]
+        elapsed = time.perf_counter() - t0
+        assert not any(dropped)
+        assert elapsed < 1.0, "a full analytics queue must not block"
+        assert ka.stats()["taps_dropped"] == 50
+    finally:
+        gate.set()
+        ka.close()
+
+
+def test_topkey_gauge_label_cardinality_bounded_by_k():
+    """ISSUE 4 acceptance: the exported top-K gauge's label set is
+    provably ≤ K at every scrape, even after far more distinct keys
+    than K churned through — departed keys' labels are removed."""
+    m = Metrics()
+    k = 4
+    ka = KeyAnalytics(metrics=m, k=k, width=2 * k)
+    try:
+        rng = np.random.default_rng(3)
+        for wave in range(6):
+            keys = rng.integers(wave * 100, wave * 100 + 50,
+                                size=200).astype(np.uint64)
+            assert ka.tap_packed(keys, np.ones(200, np.int64),
+                                 np.zeros(200))
+            assert ka.flush(timeout=10)  # republish after each wave
+            text = m.render().decode()
+            labels = [ln for ln in text.splitlines()
+                      if ln.startswith("gubernator_topkey_overlimit_total{")]
+            assert 0 < len(labels) <= k, labels
+        assert ka.stats()["tracked_keys"] <= 2 * k
+        assert "gubernator_analytics_waves_tapped_total 6.0" \
+            in m.render().decode()
+    finally:
+        ka.close()
+
+
+def test_observe_phase_feeds_histogram_and_ledger():
+    m = Metrics()
+    ka = KeyAnalytics(metrics=m, k=4)
+    try:
+        ka.observe_phase("peer_flush", 0.005)
+        text = m.render().decode()
+        assert ('gubernator_phase_duration_count{phase="peer_flush"} 1.0'
+                in text)
+        assert ka.phases_snapshot()["phases"]["peer_flush"]["count"] == 1
+    finally:
+        ka.close()
+
+
+def test_env_knobs_and_disable(monkeypatch):
+    monkeypatch.setenv("GUBER_TOPK", "32")
+    monkeypatch.setenv("GUBER_SKETCH_WIDTH", "99")
+    ka = KeyAnalytics()
+    try:
+        assert ka.sketch.k == 32 and ka.sketch.width == 99
+    finally:
+        ka.close()
+    # malformed values keep defaults
+    monkeypatch.setenv("GUBER_TOPK", "banana")
+    monkeypatch.delenv("GUBER_SKETCH_WIDTH")
+    ka = KeyAnalytics()
+    try:
+        assert ka.sketch.k == 256 and ka.sketch.width == 4 * 256
+    finally:
+        ka.close()
+
+
+# ---- end-to-end: dispatcher tap + daemon endpoints ----------------------
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    from gubernator_tpu.config import DaemonConfig
+    from gubernator_tpu.daemon import spawn_daemon
+    from gubernator_tpu.netutil import free_port
+    from gubernator_tpu.oracle import OracleEngine
+
+    d = spawn_daemon(DaemonConfig(
+        grpc_listen_address=f"127.0.0.1:{free_port()}",
+        http_listen_address=f"127.0.0.1:{free_port()}",
+        cache_size=1 << 10), engine=OracleEngine())
+    yield d
+    d.close()
+
+
+def _get(daemon, path, timeout=10):
+    url = f"http://127.0.0.1:{daemon.http_port}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as f:
+        return json.loads(f.read())
+
+
+def _post_check(daemon, key, hits=1, limit=100, timeout=60):
+    body = json.dumps({"requests": [{
+        "name": "ana_e2e", "unique_key": key, "hits": hits,
+        "limit": limit, "duration": 60_000}]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{daemon.http_port}/v1/GetRateLimits",
+        data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as f:
+        return json.loads(f.read())
+
+
+def test_debug_topkeys_end_to_end(daemon):
+    """Requests through the real serving path land in /debug/topkeys
+    with recovered key NAMES, exact counts (domain ≤ K), and the
+    over-limit tally of the keys actually driven over."""
+    for _ in range(5):
+        _post_check(daemon, "hotkey", hits=2)
+    for _ in range(3):
+        _post_check(daemon, "overkey", hits=60, limit=100)  # 3rd is over
+    body = _get(daemon, "/debug/topkeys")
+    assert body["taps_dropped"] == 0
+    by_name = {e["key"]: e for e in body["keys"]}
+    assert by_name["ana_e2e_hotkey"]["hits"] >= 10
+    assert by_name["ana_e2e_hotkey"]["err"] == 0
+    assert by_name["ana_e2e_overkey"]["over_limit"] >= 1
+    assert by_name["ana_e2e_overkey"]["khash"].startswith("0x")
+    # solo daemon: no ring owner to report
+    assert by_name["ana_e2e_hotkey"]["owner"] is None
+    # ?limit= truncates
+    limited = _get(daemon, "/debug/topkeys?limit=1")["keys"]
+    assert len(limited) == 1
+    # the topkey gauge rode along, label-bounded
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.http_port}/metrics") as f:
+        text = f.read().decode()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("gubernator_topkey_overlimit_total{")]
+    assert 0 < len(lines) <= 256
+
+
+def test_debug_phases_end_to_end(daemon):
+    _post_check(daemon, "phasekey")
+    body = _get(daemon, "/debug/phases")
+    phases = body["phases"]
+    # the oracle engine path always crosses pack/device/resolve
+    for ph in ("pack", "device", "resolve"):
+        assert phases[ph]["count"] >= 1, phases.keys()
+        assert phases[ph]["total_ms"] >= 0
+    assert body["waves"]["waves"] >= 1
+
+
+def test_debug_profile_on_demand(daemon):
+    """ISSUE 4 satellite: runtime profiling start/stop + concurrent-
+    capture 409 (GUBER_PROFILE_DIR used to be the only way in)."""
+    status = _get(daemon, "/debug/profile")
+    assert status["active"] is False
+    body = _get(daemon, "/debug/profile?seconds=1.5")
+    assert body["profiling"] is True and body["dir"]
+    # concurrent capture rejected with 409
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(daemon, "/debug/profile?seconds=1")
+    assert ei.value.code == 409
+    assert _get(daemon, "/debug/profile")["active"] is True
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if not _get(daemon, "/debug/profile")["active"]:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("profile capture never stopped")
+    import glob
+    import os
+
+    files = glob.glob(os.path.join(body["dir"], "**", "*"),
+                      recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace written"
+    kinds = [e["kind"] for e in daemon.instance.recorder.events()]
+    assert "profile_start" in kinds and "profile_stop" in kinds
+    # a fresh capture may start once the previous one finished
+    body2 = _get(daemon, "/debug/profile?seconds=0.2")
+    assert body2["profiling"] is True
+
+
+def test_debug_profile_rejects_bad_seconds(daemon):
+    for bad in ("nope", "-1", "0", "301"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(daemon, f"/debug/profile?seconds={bad}")
+        assert ei.value.code == 400
+
+
+def test_analytics_disabled_turns_endpoints_off(monkeypatch):
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.oracle import OracleEngine
+
+    monkeypatch.setenv("GUBER_ANALYTICS", "0")
+    inst = V1Instance(Config(cache_size=1 << 8), engine=OracleEngine())
+    try:
+        assert inst.analytics is None
+        assert inst.dispatcher.debug_stats()["analytics"] is None
+    finally:
+        inst.close()

@@ -174,6 +174,77 @@ def test_cli_debug_events_subcommand(daemon):
     assert lines and all("wave_completed" in ln for ln in lines)
 
 
+def test_debug_events_server_side_filters(daemon):
+    """ISSUE 4 satellite: ?kind= and ?since_seq= filter on the daemon,
+    so a polling CLI stops re-downloading the whole ring."""
+    _post_check(daemon, "k_filter")
+    evs = json.loads(_get(daemon, "/debug/events"))["events"]
+    assert len(evs) >= 3
+    mid = evs[len(evs) // 2]["seq"]
+    filt = json.loads(_get(
+        daemon, "/debug/events?kind=wave_completed"))["events"]
+    assert filt and all(e["kind"] == "wave_completed" for e in filt)
+    inc = json.loads(_get(
+        daemon, f"/debug/events?since_seq={mid}"))["events"]
+    assert inc and all(e["seq"] > mid for e in inc)
+    both = json.loads(_get(
+        daemon,
+        f"/debug/events?kind=wave_completed&since_seq={mid}&limit=1")
+    )["events"]
+    assert len(both) <= 1
+    for e in both:
+        assert e["kind"] == "wave_completed" and e["seq"] > mid
+    assert json.loads(_get(
+        daemon, "/debug/events?kind=no_such_kind"))["events"] == []
+
+
+def test_cli_debug_events_since_seq_flag(daemon):
+    _post_check(daemon, "k_seq")
+    r = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cli", "debug",
+         "events", "--url", f"http://127.0.0.1:{daemon.http_port}",
+         "--since-seq", "999999", "--json"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["events"] == []
+
+
+def test_cli_debug_topkeys_subcommand(daemon):
+    """ISSUE 4: `guber-cli debug topkeys` round trip — the served key
+    shows up by NAME with its hit count."""
+    for _ in range(3):
+        _post_check(daemon, "k_top")
+    r = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cli", "debug",
+         "topkeys", "--url", f"http://127.0.0.1:{daemon.http_port}",
+         "--json"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    body = json.loads(r.stdout)
+    by_name = {e["key"]: e for e in body["keys"]}
+    assert by_name["obs_k_top"]["hits"] >= 3
+    # human format: one line per key, heaviest first
+    r2 = subprocess.run(
+        [sys.executable, "-m", "gubernator_tpu.cmd.cli", "debug",
+         "topkeys", "--url", f"http://127.0.0.1:{daemon.http_port}",
+         "--limit", "2"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 0, r2.stderr
+    assert "obs_k_top" in r2.stdout
+    assert "admission_err" in r2.stdout.splitlines()[0]
+
+
+def test_healthz_deep_reports_analytics_block(daemon):
+    deep = json.loads(_get(daemon, "/healthz?deep=1"))
+    ana = deep["dispatcher"]["analytics"]
+    assert ana["waves_tapped"] >= 1
+    assert ana["taps_dropped"] == 0
+    assert ana["k"] == 256
+
+
 def test_healthcheck_cli_deep(daemon):
     r = subprocess.run(
         [sys.executable, "-m", "gubernator_tpu.cmd.healthcheck",

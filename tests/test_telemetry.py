@@ -77,6 +77,33 @@ def test_recorder_capacity_validation():
         FlightRecorder(capacity=0)
 
 
+def test_recorder_server_side_kind_and_since_seq_filters():
+    """ISSUE 4 satellite: events() filters by kind and by seq so the
+    daemon can serve ?kind= / ?since_seq= without shipping the ring."""
+    r = FlightRecorder()
+    for i in range(6):
+        r.record("even" if i % 2 == 0 else "odd", i=i)
+    assert [e["i"] for e in r.events(kind="odd")] == [1, 3, 5]
+    assert [e["i"] for e in r.events(since_seq=4)] == [4, 5]
+    assert [e["i"] for e in r.events(kind="even", since_seq=2)] == [2, 4]
+    assert [e["i"] for e in r.events(kind="even", limit=1)] == [4]
+    assert r.events(kind="nope") == []
+
+
+def test_recorder_keeps_dict_fields_queryable():
+    """The wave_completed `phases` block must survive as a JSON object,
+    not a repr string (one level deep; nested values still coerce)."""
+    import json
+
+    r = FlightRecorder()
+    r.record("wave_completed", phases={"pack": 0.5, "device": 2.0},
+             weird={"obj": object()})
+    ev = r.events()[0]
+    json.dumps(ev)
+    assert ev["phases"] == {"pack": 0.5, "device": 2.0}
+    assert ev["weird"]["obj"].startswith("<object object")
+
+
 # ---- dispatcher wave metrics --------------------------------------------
 
 
@@ -155,6 +182,108 @@ def test_engine_error_recorded_as_wave_error(engine):
         d.close()
     errs = [e for e in rec.events() if e["kind"] == "wave_error"]
     assert errs and errs[0]["error"] == "device on fire"
+
+
+# ---- per-phase latency attribution (ISSUE 4) ----------------------------
+
+
+def _phase_sums(text):
+    import re
+
+    out = {}
+    for ph, v in re.findall(
+            r'gubernator_phase_duration_sum\{phase="(\w+)"\} (\S+)',
+            text):
+        out[ph] = float(v)
+    return out
+
+
+def test_phase_histograms_partition_wave_duration(engine):
+    """ISSUE 4 acceptance: pack + device + resolve sum to the existing
+    wave_duration (same clock, marks stamp segment ends), over inline
+    AND queued waves."""
+    from gubernator_tpu.analytics import KeyAnalytics
+
+    m, rec = Metrics(), FlightRecorder()
+    ka = KeyAnalytics(metrics=m)
+    d = Dispatcher(engine, metrics=m, recorder=rec, analytics=ka)
+    try:
+        for i in range(4):  # inline waves
+            d.check_batch([req(f"p{i}")], NOW + i)
+        # queued path: coalesced wave with queue-wait samples
+        d._inline_mu.acquire()
+        try:
+            threads = [threading.Thread(
+                target=lambda i=i: d.check_batch([req(f"pq{i}")], NOW))
+                for i in range(3)]
+            for t in threads:
+                t.start()
+        finally:
+            d._inline_mu.release()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        d.close()
+        ka.close()
+    import re
+
+    text = m.render().decode()
+    sums = _phase_sums(text)
+    wave_sum = float(re.search(
+        r"gubernator_dispatcher_wave_duration_sum (\S+)", text).group(1))
+    in_wave = sums["pack"] + sums["device"] + sums["resolve"]
+    assert in_wave == pytest.approx(wave_sum, rel=1e-6, abs=1e-9)
+    # queue_wait mirrors the dispatcher's own histogram sample count
+    qw = float(re.search(
+        r'gubernator_phase_duration_count\{phase="queue_wait"\} (\S+)',
+        text).group(1))
+    qw_disp = float(re.search(
+        r"gubernator_dispatcher_queue_wait_count (\S+)", text).group(1))
+    assert qw == qw_disp == 3.0
+    # the per-wave breakdown rode the flight-recorder events and sums
+    # to each wave's duration
+    for ev in rec.events(kind="wave_completed"):
+        ph = ev["phases"]
+        assert set(ph) == {"pack", "device", "resolve"}
+        assert sum(ph.values()) == pytest.approx(ev["duration_ms"],
+                                                 abs=0.002)
+
+
+def test_phase_histogram_without_analytics_attached(engine):
+    """Phase attribution must not require the analytics subsystem: a
+    dispatcher with metrics but analytics=None still feeds the
+    histograms (and nothing crashes on the tap paths)."""
+    m = Metrics()
+    d = Dispatcher(engine, metrics=m)
+    try:
+        d.check_batch([req("na")], NOW)
+    finally:
+        d.close()
+    sums = _phase_sums(m.render().decode())
+    assert set(sums) >= {"pack", "device", "resolve"}
+
+
+def test_wave_error_still_recorded_with_marks(engine):
+    """An engine raise mid-wave (after the pack mark) must not break
+    phase segmentation on the error path."""
+    from gubernator_tpu.analytics import KeyAnalytics
+
+    ka = KeyAnalytics(metrics=None)
+    rec = FlightRecorder()
+    d = Dispatcher(engine, recorder=rec, analytics=ka)
+
+    def boom(reqs, now):
+        raise RuntimeError("mid-wave")
+
+    d.engine = type("E", (), {"check_batch": staticmethod(boom)})()
+    try:
+        with pytest.raises(RuntimeError, match="mid-wave"):
+            d.check_batch([req("x")], NOW)
+    finally:
+        d.close()
+        ka.close()
+    errs = rec.events(kind="wave_error")
+    assert errs and errs[0]["error"] == "mid-wave"
 
 
 # ---- stall watchdog (fake clock, no real sleeps) ------------------------

@@ -1,4 +1,5 @@
-"""Metric-catalog lint (tier-1 via tests/test_check_metrics.py).
+"""Metric-catalog + event-kind lint (tier-1 via
+tests/test_check_metrics.py).
 
 Asserts, against a fresh ``Metrics()`` registry:
 
@@ -7,7 +8,12 @@ Asserts, against a fresh ``Metrics()`` registry:
    but two attributes pointing at lookalike names would not);
 2. every registered metric is documented in OBSERVABILITY.md;
 3. every ``gubernator_*`` name OBSERVABILITY.md documents actually
-   exists — a stale doc is how the metrics.py docstring drifted before.
+   exists — a stale doc is how the metrics.py docstring drifted before;
+4. every flight-recorder event ``kind`` emitted through telemetry.py
+   (literal first arguments to ``.record(...)`` / ``.record_error(...)``
+   / ``._record_event(...)`` anywhere under gubernator_tpu/) appears in
+   OBSERVABILITY.md's event table, and vice versa — an undocumented
+   event kind is invisible to whoever greps the doc mid-incident.
 
 Exit 0 when clean; prints each violation and exits 1 otherwise.
 """
@@ -40,6 +46,42 @@ def _canonical(name: str, reg_set) -> str:
     return name
 
 
+#: literal event kinds at FlightRecorder call sites.  Variable-kind
+#: calls (e.g. global_manager's _record_event(kind, ...) helper body)
+#: don't match — their literal call sites do.
+_KIND_RX = re.compile(
+    r"\.(?:record|record_error|_record_event)\(\s*[\"']([a-z0-9_]+)[\"']")
+
+
+def emitted_event_kinds(pkg_dir: str) -> set:
+    kinds = set()
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn), encoding="utf-8") as f:
+                kinds.update(_KIND_RX.findall(f.read()))
+    return kinds
+
+
+def documented_event_kinds(doc: str) -> set:
+    """Backticked names in the first column of the flight-recorder
+    event table (the section between '## Flight recorder' and the next
+    '## ' heading); one row may document several kinds."""
+    try:
+        section = doc.split("## Flight recorder", 1)[1]
+    except IndexError:
+        return set()
+    section = section.split("\n## ", 1)[0]
+    kinds = set()
+    for line in section.splitlines():
+        if not line.startswith("| `"):
+            continue
+        first_cell = line.split("|")[1]
+        kinds.update(re.findall(r"`([a-z0-9_]+)`", first_cell))
+    return kinds
+
+
 def main() -> int:
     from gubernator_tpu.metrics import Metrics
 
@@ -67,11 +109,23 @@ def main() -> int:
             f"OBSERVABILITY.md documents {name!r} but no such metric "
             f"is registered (stale doc entry)")
 
+    emitted = emitted_event_kinds(os.path.join(REPO, "gubernator_tpu"))
+    doc_kinds = documented_event_kinds(doc)
+    for kind in sorted(emitted - doc_kinds):
+        problems.append(
+            f"event kind {kind!r} is emitted via telemetry.py but "
+            f"missing from the OBSERVABILITY.md event table")
+    for kind in sorted(doc_kinds - emitted):
+        problems.append(
+            f"OBSERVABILITY.md's event table documents kind {kind!r} "
+            f"but nothing emits it (stale doc entry)")
+
     if problems:
         for p in problems:
             print(f"check_metrics: {p}", file=sys.stderr)
         return 1
-    print(f"check_metrics: OK ({len(reg_set)} metrics, all documented)")
+    print(f"check_metrics: OK ({len(reg_set)} metrics, "
+          f"{len(emitted)} event kinds, all documented)")
     return 0
 
 

@@ -2,10 +2,15 @@
 
 PY ?= python
 
-.PHONY: test proto bench tpu-session b-sweep daemon cluster lint native clean
+.PHONY: test proto bench chaos tpu-session b-sweep daemon cluster lint native clean
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# faultpoint × {error,delay} matrix against an in-proc cluster; exits
+# nonzero if any injected fault hangs the daemon or breaks recovery
+chaos:
+	$(PY) tools/chaos_matrix.py
 
 proto:
 	cd gubernator_tpu/proto && protoc -I. --python_out=. \

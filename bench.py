@@ -657,6 +657,89 @@ def _analytics_ab(inst, call, pairs=5, reps=30) -> dict:
         disp.analytics = ana
 
 
+def _faults_ab(inst, call, pairs=5, reps=30) -> dict:
+    """ISSUE 5 acceptance: fault injection must be zero-cost while
+    disarmed (<1% on the service path with GUBER_FAULT unset).
+
+    Interleaved timing pairs of the same call in three states:
+    *disarmed* (the shipping default — every instrumented site pays one
+    attribute read), *detached* (the FaultSet reference removed /
+    stubbed, the closest runtime proxy for uninstrumented code), and
+    *armed* on an off-path point (``snapshot:error`` — the gate is hot,
+    every site pays the lock + match).  ``disarmed_overhead_pct`` is
+    the acceptance number (disarmed vs detached); ``armed_noop_pct``
+    records what arming costs, i.e. what the disarmed gate saves.  The
+    true pre-instrumentation baseline is the row's recorded pre-PR
+    trajectory (concurrent16)."""
+    disp = inst.dispatcher
+    fs = inst.faults
+
+    class _Detached:  # armed=False: byte-for-byte the disarmed branch
+        armed = False
+
+    dummy = _Detached()
+
+    def rate():
+        t0 = time.perf_counter()
+        for r in range(reps):
+            call(r)
+        return reps / (time.perf_counter() - t0)
+
+    def _state(which):
+        if which == "det":
+            inst.faults = dummy
+            disp._faults = None
+            return
+        inst.faults = fs
+        disp._faults = fs
+        fs.arm("snapshot:error" if which == "arm" else "")
+
+    def _measure(which):
+        _state(which)
+        try:
+            return rate()
+        finally:
+            _state("dis")
+
+    try:
+        r_dis, r_det, r_arm = [], [], []
+        for pair in range(pairs + 1):
+            # alternate order per pair so monotonic host drift cancels
+            # in the per-pair ratios instead of biasing them
+            order = (("dis", "det", "arm") if pair % 2
+                     else ("arm", "det", "dis"))
+            got = {w: _measure(w) for w in order}
+            if pair == 0:
+                continue  # warmup pair, untimed
+            r_dis.append(got["dis"])
+            r_det.append(got["det"])
+            r_arm.append(got["arm"])
+        disarmed = (float(np.median([d / x for d, x
+                                     in zip(r_det, r_dis)])) - 1) * 100
+        armed = (float(np.median([d / x for d, x
+                                  in zip(r_dis, r_arm)])) - 1) * 100
+        row = {"disarmed_overhead_pct": round(disarmed, 2),
+               "overhead_ok": bool(disarmed < 1.0),
+               "armed_noop_pct": round(armed, 2),
+               "disarmed_calls_per_s": round(float(np.median(r_dis)), 1),
+               "pairs": pairs, "reps": reps}
+        if not row["overhead_ok"]:
+            row["warning"] = ("disarmed faultpoint checks measured "
+                              "above the 1% budget on this run; "
+                              "single-host noise — re-run before "
+                              "acting on it")
+        return row
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+    finally:
+        inst.faults = fs
+        disp._faults = fs
+        try:
+            fs.arm("")
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _serialize_reqs(reqs_lists):
     """[[RateLimitRequest]] → serialized GetRateLimitsReq bytes."""
     from gubernator_tpu.proto import gubernator_pb2 as pb
@@ -966,6 +1049,15 @@ def _sec_svc():
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["analytics_ab"] = {
                 "error": (str(e) or repr(e))[:200]}
+        # ISSUE 5 acceptance: disarmed faultpoint checks must cost <1%
+        # on the service path (same request bytes as the loops above)
+        try:
+            out["6_service_path"]["faults_ab"] = _faults_ab(
+                inst, lambda r: inst.get_rate_limits_wire(
+                    datas[r % 4], now_ms=NOW0 + 700 + r))
+        except Exception as e:  # noqa: BLE001
+            out["6_service_path"]["faults_ab"] = {
+                "error": (str(e) or repr(e))[:200]}
         _section_checkpoint(out)
         # peer-forwarding path: what the owner-side apply of a
         # forwarded batch takes, via its wire lane (since ISSUE 3 the
@@ -1070,6 +1162,39 @@ def _sec_cluster():
                "wire_clustered_requests": int(lane),
                "conservation_exact": conserved,
                "telemetry": _telemetry_rows(inst0)}
+        # ISSUE 5: degraded-mode throughput vs the healthy baseline —
+        # fault-kill one owner's forwards (faults.py) and remeasure the
+        # same loop; rows owned by the dead peer answer locally with
+        # the degraded flag instead of error rows.  The first reps pay
+        # retry+backoff until the circuit opens, then fail-fast +
+        # local serve — that transition is part of the number.
+        try:
+            vaddr = c3.peer_at(2).grpc_address
+            inst0.faults.arm(f"peer_send@{vaddr}:error", seed=7)
+            inst0.get_rate_limits_wire(datas[0], now_ms=NOW0 + 500)
+            t0 = time.perf_counter()
+            for r in range(reps):
+                inst0.get_rate_limits_wire(datas[r % 4],
+                                           now_ms=NOW0 + 501 + r)
+            dps_deg = reps * 1000 / (time.perf_counter() - t0)
+            fam = inst0.metrics.degraded_served.collect()[0]
+            deg_rows = sum(s.value for s in fam.samples
+                           if s.name.endswith("_total"))
+            row["degraded"] = {
+                "decisions_per_s": round(dps_deg),
+                "vs_healthy": round(dps_deg / dps_c3, 3),
+                "degraded_rows_served": int(deg_rows),
+                "context": ("one of three owners' forwards fault-"
+                            "killed (peer_send@addr:error); its keys "
+                            "serve degraded from the local shard — "
+                            "RESILIENCE.md")}
+        except Exception as e:  # noqa: BLE001
+            row["degraded"] = {"error": (str(e) or repr(e))[:200]}
+        finally:
+            try:
+                inst0.faults.clear()
+            except Exception:  # noqa: BLE001
+                pass
         cores = _host_cores()
         if cores < 3:
             # VERDICT r2 weak #3: without this, the row reads as a

@@ -50,7 +50,7 @@ import numpy as np
 #: wave (job queue wait, wire ingest, response build, peer flush).
 IN_WAVE_PHASES = ("pack", "device", "resolve")
 PHASES = ("ingest", "pack", "queue_wait", "device", "resolve", "build",
-          "peer_flush")
+          "peer_flush", "broadcast", "snapshot", "restore")
 
 
 def _env_int(name: str, default: int, lo: int = 1) -> int:
@@ -359,6 +359,15 @@ class PhaseLedger:
             a[0] += 1
             a[1] += seconds
             self._recent[phase].append(seconds)
+
+    def mean(self, phase: str) -> Optional[float]:
+        """Cheap mean seconds per sample for one phase (None before any
+        sample) — the dispatcher's admission control projects queue
+        waits from these (ISSUE 5) without paying snapshot()'s
+        percentile math."""
+        with self._mu:
+            a = self._agg.get(phase)
+            return (a[1] / a[0]) if a and a[0] else None
 
     def snapshot(self) -> Dict[str, dict]:
         with self._mu:

@@ -61,9 +61,26 @@ def _debug_main(argv) -> int:
     tk.add_argument("--timeout", type=float, default=10.0)
     tk.add_argument("--json", action="store_true",
                     help="print the raw JSON document")
+    fl = sub.add_parser("faults",
+                        help="inspect or arm the daemon's fault-"
+                             "injection points (/debug/faults)")
+    fl.add_argument("--url", default="http://localhost:1050",
+                    help="daemon HTTP base url")
+    fl.add_argument("--set", dest="spec", default=None,
+                    help="arm this fault spec (e.g. "
+                         "'peer_send:error:0.3,device_step:delay:50ms')")
+    fl.add_argument("--seed", type=int, default=None,
+                    help="deterministic seed for the armed points")
+    fl.add_argument("--clear", action="store_true",
+                    help="disarm every faultpoint")
+    fl.add_argument("--timeout", type=float, default=10.0)
+    fl.add_argument("--json", action="store_true",
+                    help="print the raw JSON document")
     args = ap.parse_args(argv)
     if args.what == "topkeys":
         return _debug_topkeys(args)
+    if args.what == "faults":
+        return _debug_faults(args)
 
     url = args.url
     if "/debug/events" not in url:
@@ -137,6 +154,44 @@ def _debug_topkeys(args) -> int:
         print(line)
     if not keys:
         print("(no keys tracked)", file=sys.stderr)
+    return 0
+
+
+def _debug_faults(args) -> int:
+    """``debug faults``: round-trip the daemon's fault-injection state
+    (GET /debug/faults; --set/--clear POST a new spec)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/debug/faults"
+    try:
+        if args.clear or args.spec is not None:
+            payload = ({"clear": True} if args.clear
+                       else {"spec": args.spec, "seed": args.seed})
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=args.timeout) as f:
+                body = json.loads(f.read())
+        else:
+            body = _fetch_json(url, args.timeout)
+    except Exception as e:  # noqa: BLE001
+        print(f"fetch failed: {e!r}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body))
+        return 0
+    state = "ARMED" if body.get("armed") else "disarmed"
+    print(f"faults {state} (seed={body.get('seed')}) "
+          f"spec={body.get('spec') or '-'}")
+    for p in body.get("points", []):
+        tag = f"@{p['tag']}" if p.get("tag") else ""
+        extra = (f" delay={p['delay_ms']}ms" if p.get("delay_ms")
+                 else "")
+        print(f"  {p['point']}{tag}:{p['mode']} p={p['prob']}{extra} "
+              f"checked={p['checked']} fired={p['fired']}")
+    if not body.get("points"):
+        print(f"  (catalog: {', '.join(body.get('catalog', []))})")
     return 0
 
 

@@ -101,6 +101,24 @@ class BehaviorConfig:
     peer_circuit_threshold: int = 3
     peer_circuit_cooldown_ms: int = 2000
 
+    #: Failure-domain resilience (ISSUE 5).  When a forward fails (RPC
+    #: error after retries, or a circuit-open fail-fast), answer the
+    #: row from the LOCAL shard with a DEGRADED response flag and
+    #: reconcile the hits to the owner through the GLOBAL hit-flush
+    #: queues — bounded staleness instead of per-request error rows.
+    #: Rows with state-mutating flags (RESET_REMAINING /
+    #: DRAIN_OVER_LIMIT) are never served degraded.
+    peer_degraded_fallback: bool = True
+    #: Health-gated routing ring: a peer whose circuit has been open
+    #: continuously for peer_eject_after_ms is EJECTED from the routing
+    #: ring (its keys deterministically rehome to the next ring point);
+    #: it returns only after staying recovered for
+    #: peer_readmit_after_ms (hysteresis against flapping).  False
+    #: keeps the membership ring authoritative for routing.
+    peer_health_gate: bool = True
+    peer_eject_after_ms: int = 3000
+    peer_readmit_after_ms: int = 3000
+
 
 @dataclass
 class Config:
@@ -228,6 +246,11 @@ class DaemonConfig:
     k8s_insecure_skip_verify: bool = False
     memberlist_known_hosts: List[str] = field(default_factory=list)
 
+    #: Graceful-shutdown drain window (ms): Daemon.close reports
+    #: "draining" on /healthz (503) for this long before stopping the
+    #: listeners, so load balancers stop routing first.  0 skips the
+    #: wait (the drain events still fire).
+    drain_grace_ms: int = 0
     #: Path for Loader snapshots ("" disables checkpoint/resume).
     snapshot_path: str = ""
     #: Decision-step implementation ("" → "xla"; "pallas" = the Mosaic
@@ -346,6 +369,18 @@ def setup_daemon_config(conf_file: str = "",
         parse_duration_ms)
     b.multi_region_batch_limit = src.get(
         "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit, int)
+    b.peer_degraded_fallback = src.get("GUBER_PEER_DEGRADED_FALLBACK",
+                                       b.peer_degraded_fallback, bool)
+    b.peer_health_gate = src.get("GUBER_PEER_HEALTH_GATE",
+                                 b.peer_health_gate, bool)
+    b.peer_eject_after_ms = src.get("GUBER_PEER_EJECT_AFTER",
+                                    b.peer_eject_after_ms,
+                                    parse_duration_ms)
+    b.peer_readmit_after_ms = src.get("GUBER_PEER_READMIT_AFTER",
+                                      b.peer_readmit_after_ms,
+                                      parse_duration_ms)
+    d.drain_grace_ms = src.get("GUBER_DRAIN_GRACE", d.drain_grace_ms,
+                               parse_duration_ms)
 
     d.peer_discovery_type = src.get("GUBER_PEER_DISCOVERY_TYPE",
                                     d.peer_discovery_type)

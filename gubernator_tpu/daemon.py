@@ -25,6 +25,7 @@ import grpc
 
 from .config import DaemonConfig
 from .discovery import make_discovery
+from .dispatcher import ResourceExhausted, request_deadline
 from .grpc_api import (add_health_servicer, add_peers_servicer_raw,
                        add_v1_servicer_raw)
 from .instance import V1Instance
@@ -47,12 +48,16 @@ class _V1Servicer:
 
     def GetRateLimits(self, request: pb.GetRateLimitsReq, context):
         with grpc_request_context(context), \
-                span("grpc.GetRateLimits", metrics=self.instance.metrics):
+                span("grpc.GetRateLimits", metrics=self.instance.metrics), \
+                request_deadline(context.time_remaining()):
             try:
                 reqs = [req_from_pb(m) for m in request.requests]
                 resps = self.instance.get_rate_limits(reqs)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, exc_text(e))
+            except ResourceExhausted as e:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              exc_text(e))
             out = pb.GetRateLimitsResp()
             out.responses.extend(resp_to_pb(r) for r in resps)
             return out
@@ -60,13 +65,18 @@ class _V1Servicer:
     def GetRateLimitsWire(self, request: bytes, context):
         """Raw-bytes twin of GetRateLimits (grpc_api.add_v1_servicer_raw):
         lets the instance's C++ wire lane run decode→decide→encode
-        without pb2 when the batch qualifies."""
+        without pb2 when the batch qualifies.  The caller's remaining
+        deadline scopes deadline-aware admission shedding (ISSUE 5)."""
         with grpc_request_context(context), \
-                span("grpc.GetRateLimits", metrics=self.instance.metrics):
+                span("grpc.GetRateLimits", metrics=self.instance.metrics), \
+                request_deadline(context.time_remaining()):
             try:
                 return self.instance.get_rate_limits_wire(request)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, exc_text(e))
+            except ResourceExhausted as e:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              exc_text(e))
 
     def HealthCheck(self, request: pb.HealthCheckReq, context):
         return health_to_pb(self.instance.health_check())
@@ -94,11 +104,15 @@ class _PeersServicer:
         """Raw-bytes twin of GetPeerRateLimits (C++ wire lane)."""
         with grpc_request_context(context), \
                 span("grpc.GetPeerRateLimits",
-                     metrics=self.instance.metrics):
+                     metrics=self.instance.metrics), \
+                request_deadline(context.time_remaining()):
             try:
                 return self.instance.get_peer_rate_limits_wire(request)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, exc_text(e))
+            except ResourceExhausted as e:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              exc_text(e))
 
     def UpdatePeerGlobals(self, request: peers_pb.UpdatePeerGlobalsReq,
                           context):
@@ -149,6 +163,10 @@ class Daemon:
         self.cfg = cfg
         self.tls = setup_tls(cfg.tls)
         self._closed = False
+        #: drain-aware shutdown (ISSUE 5): True from the moment close()
+        #: starts; /healthz answers 503 "draining" for the grace window
+        #: before the listeners stop
+        self._draining = False
         self.profiler = DeviceProfiler.from_env()
         #: on-demand device profiling (GET /debug/profile?seconds=N):
         #: at most ONE capture at a time — jax.profiler is process-
@@ -290,6 +308,15 @@ class Daemon:
                     self._send(200, daemon.instance.metrics.render(),
                                "text/plain; version=0.0.4")
                 elif path in ("/v1/HealthCheck", "/healthz"):
+                    if daemon._draining:
+                        # drain-aware probe (ISSUE 5): load balancers
+                        # must stop routing BEFORE the listener dies
+                        self._send(503, json.dumps(
+                            {"status": "draining",
+                             "message": "daemon is shutting down",
+                             "peer_count": len(
+                                 daemon.instance.peers())}).encode())
+                        return
                     h = daemon.instance.health_check()
                     code = 200 if h.status == "healthy" else 503
                     body = {"status": h.status, "message": h.message,
@@ -371,10 +398,35 @@ class Daemon:
                 elif path == "/debug/profile":
                     code, body = daemon._handle_profile(q)
                     self._send(code, json.dumps(body).encode())
+                elif path == "/debug/faults":
+                    # fault-injection state (faults.py): armed spec,
+                    # per-point check/fire counters, catalog
+                    self._send(200, json.dumps(
+                        daemon.instance.faults.describe()).encode())
                 else:
                     self._send(404, b'{"error":"not found"}')
 
             def do_POST(self):
+                if self.path == "/debug/faults":
+                    # arm/clear faultpoints at runtime (chaos drills):
+                    # {"spec": "peer_send:error:0.3", "seed": 7} or
+                    # {"clear": true}
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        payload = json.loads(
+                            self.rfile.read(length) or b"{}")
+                        if payload.get("clear"):
+                            out = daemon.instance.faults.clear()
+                        else:
+                            out = daemon.instance.faults.arm(
+                                payload.get("spec", ""),
+                                seed=payload.get("seed"))
+                    except (ValueError, TypeError) as e:
+                        self._send(400, json.dumps(
+                            {"error": exc_text(e)}).encode())
+                        return
+                    self._send(200, json.dumps(out).encode())
+                    return
                 if self.path not in ("/v1/GetRateLimits",
                                      "/v1/V1/GetRateLimits"):
                     self._send(404, b'{"error":"not found"}')
@@ -388,6 +440,12 @@ class Daemon:
                         resps = daemon.instance.get_rate_limits(reqs)
                 except ValueError as e:
                     self._send(400, json.dumps(
+                        {"error": exc_text(e)}).encode())
+                    return
+                except ResourceExhausted as e:
+                    # admission shed / drain: 429, the HTTP analog of
+                    # grpc RESOURCE_EXHAUSTED
+                    self._send(429, json.dumps(
                         {"error": exc_text(e)}).encode())
                     return
                 self._send(200, json.dumps({
@@ -487,13 +545,33 @@ class Daemon:
     def close(self) -> None:
         """Graceful shutdown (daemon.go › Daemon.Close, SURVEY.md §3.5).
 
-        Listeners stop FIRST so no request lands after the instance has
-        flushed its async managers and written the Loader snapshot —
-        mutations during the shutdown window would be lost on restart."""
+        Drain FIRST (ISSUE 5): /healthz flips to 503 "draining", the
+        dispatcher sheds new ingress with RESOURCE_EXHAUSTED, and the
+        listeners stay up for ``drain_grace_ms`` so load balancers stop
+        routing before connections die.  Then listeners stop, so no
+        request lands after the instance has flushed its async managers
+        and written the Loader snapshot — mutations during the shutdown
+        window would be lost on restart."""
         if self._closed:
             return
         self._closed = True
+        import time as _time
+
+        self._draining = True
+        if self.instance is not None:
+            self.instance.recorder.record(
+                "drain_started", grace_ms=self.cfg.drain_grace_ms)
+            self.instance.metrics.draining.set(1)
+            # during the grace window requests still SERVE (the point
+            # is to let load balancers notice the 503 probe first);
+            # only after it does the dispatcher shed new ingress
+            grace = max(int(getattr(self.cfg, "drain_grace_ms", 0)), 0)
+            if grace > 0:
+                _time.sleep(grace / 1000.0)
+            self.instance.dispatcher.drain()
         self._teardown()
+        if self.instance is not None:
+            self.instance.recorder.record("drain_completed")
 
     def _teardown(self) -> None:
         if self.discovery is not None:

@@ -26,9 +26,37 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import List, Optional, Sequence, Tuple
 
+from contextlib import contextmanager
+from contextvars import ContextVar
+
 from .types import RateLimitRequest, RateLimitResponse
 
 log = logging.getLogger("gubernator_tpu.dispatcher")
+
+
+class ResourceExhausted(RuntimeError):
+    """Raised at ingress when admission control sheds a request batch
+    (bounded queue full, projected queue-wait past the caller deadline,
+    or drain mode).  The daemon maps it to grpc RESOURCE_EXHAUSTED /
+    HTTP 429 — shedding must be CHEAP and explicit, never a timeout."""
+
+
+#: caller deadline for deadline-aware shedding, set by the serving
+#: front door (grpc context.time_remaining / HTTP timeout header) and
+#: read by Dispatcher.admit in the same thread/context
+_REQUEST_DEADLINE: "ContextVar[Optional[float]]" = ContextVar(
+    "guber_request_deadline", default=None)
+
+
+@contextmanager
+def request_deadline(seconds: Optional[float]):
+    """Scope the caller's remaining deadline (seconds) for admission
+    control; None means 'no deadline' (only queue-full/drain shed)."""
+    tok = _REQUEST_DEADLINE.set(seconds)
+    try:
+        yield
+    finally:
+        _REQUEST_DEADLINE.reset(tok)
 
 
 def _job_len(job) -> int:
@@ -129,12 +157,20 @@ class Dispatcher:
     #: depth 1 degenerates to launch-then-sync, i.e. no overlap).
     PIPELINE_DEPTH = 2
 
+    #: default admission bound: rows queued (not yet launched) before
+    #: ingress sheds with RESOURCE_EXHAUSTED.  GUBER_ADMISSION_LIMIT
+    #: overrides; 0 disables the bound (deadline/drain shed remain).
+    ADMISSION_LIMIT_WAVES = 8
+
     def __init__(self, engine, max_wave: int = 8192,
                  max_delay_ms: float = 0.2,
                  lock: Optional[threading.Lock] = None,
                  metrics=None, recorder=None, clock=time.monotonic,
-                 analytics=None):
+                 analytics=None, faults=None):
         self.engine = engine
+        #: optional FaultSet (faults.py): dispatch_enqueue / _launch /
+        #: _sync / device_step faultpoints
+        self._faults = faults
         #: key-level analytics subsystem (analytics.py › KeyAnalytics,
         #: optional): resolved waves tap their khash/hits/status
         #: columns into its worker queue AFTER the wave ends — strictly
@@ -195,6 +231,22 @@ class Dispatcher:
         self._carry = None
         self._closing = threading.Event()
         self._submit_mu = threading.Lock()  # serializes submit vs close
+        # ---- overload admission control (ISSUE 5) ----
+        # bounded ingress: _queued_rows tracks rows submitted but not
+        # yet pulled into a wave; admit() sheds past the limit, when
+        # the projected queue wait exceeds the caller's deadline, or in
+        # drain mode.  All under _submit_mu (brief).
+        adm_env = os.environ.get("GUBER_ADMISSION_LIMIT", "")
+        try:
+            self.admission_limit = (int(adm_env) if adm_env
+                                    else self.ADMISSION_LIMIT_WAVES
+                                    * self.max_wave)
+        except ValueError:
+            self.admission_limit = self.ADMISSION_LIMIT_WAVES * self.max_wave
+        self._queued_rows = 0
+        self._draining = False
+        self._shed_rows = 0
+        self._last_shed_event = 0.0  # recorder rate limit (1/s/reason)
         #: one idle-path inline runner at a time (see _try_inline)
         self._inline_mu = threading.Lock()
         #: pipelining needs BOTH the policy and the engine capability —
@@ -312,6 +364,7 @@ class Dispatcher:
             try:
                 self._wave_mark(wid, "pack")
                 with self._engine_lock:
+                    self._fault("device_step")
                     out = fn()
                 self._wave_mark(wid, "device")
             except Exception as e:  # noqa: BLE001 - recorded, re-raised
@@ -338,6 +391,7 @@ class Dispatcher:
                 try:
                     self._wave_mark(wid, "pack")
                     with self._engine_lock:
+                        self._fault("device_step")
                         out = self.engine.check_batch(list(reqs), now_ms)
                     self._wave_mark(wid, "device")
                 except Exception as e:  # noqa: BLE001 - recorded, re-raised
@@ -376,6 +430,7 @@ class Dispatcher:
                 try:
                     self._wave_mark(wid, "pack")
                     with self._engine_lock:
+                        self._fault("device_step")
                         out = self.engine.check_packed(batch, khash,
                                                        now_ms)
                     self._wave_mark(wid, "device")
@@ -394,9 +449,97 @@ class Dispatcher:
         except FuturesTimeout as e:
             raise self._result_timeout(e) from e
 
+    def _fault(self, point: str) -> None:
+        f = self._faults
+        if f is not None and f.armed:
+            f.fire(point)
+
+    # ---- overload admission control (ISSUE 5) ---------------------------
+
+    def _shed(self, reason: str, nrows: int) -> None:
+        if self.metrics is not None:
+            self.metrics.admission_shed.labels(reason=reason).inc(nrows)
+        with self._submit_mu:
+            self._shed_rows += nrows
+            now = self._clock()
+            throttled = now - self._last_shed_event < 1.0
+            if not throttled:
+                self._last_shed_event = now
+        if self.recorder is not None and not throttled:
+            # rate-limited: under sustained overload one event per
+            # second, not one per rejected call
+            self.recorder.record("admission_shed", reason=reason,
+                                 rows=nrows,
+                                 queued_rows=self._queued_rows)
+        raise ResourceExhausted(
+            f"admission control shed {nrows} requests ({reason}: "
+            f"queued_rows={self._queued_rows}, "
+            f"limit={self.admission_limit})")
+
+    def projected_queue_wait_s(self, extra_rows: int = 0) -> float:
+        """Projected QUEUE WAIT for work entering now: how long the
+        rows already ahead (+ ``extra_rows``) take to drain, from
+        observed service rates.  The per-wave service time prefers the
+        analytics PhaseLedger's per-phase means (pack+device+resolve,
+        ISSUE 4), falling back to the recent-wave deques; an empty
+        queue projects 0 — your wave launches immediately."""
+        with self._tel_mu:
+            queued = self._queued_rows + extra_rows
+            sizes = list(self._recent_sizes)
+            durs = list(self._recent_durs)
+        if queued <= 0:
+            return 0.0
+        wave_s = None
+        ana = self.analytics
+        if ana is not None:
+            means = [ana.phases.mean(p)
+                     for p in ("pack", "device", "resolve")]
+            if any(m is not None for m in means):
+                wave_s = sum(m for m in means if m is not None)
+        if wave_s is None:
+            if not durs:
+                return 0.0
+            wave_s = sum(durs) / len(durs)
+        # queued rows coalesce into waves of up to max_wave rows each,
+        # but never better than the sizes actually observed
+        avg_rows = max(sum(sizes) / max(len(sizes), 1), 1.0)
+        rows_per_wave = min(max(avg_rows, queued), self.max_wave)
+        import math
+
+        return math.ceil(queued / rows_per_wave) * wave_s
+
+    def admit(self, nrows: int, deadline_s: Optional[float] = None
+              ) -> None:
+        """Deadline-aware ingress gate: raise ResourceExhausted instead
+        of queueing work that cannot finish.  Cheap — a couple of
+        reads; no device work, no allocation on the admit path.
+        Deadline shedding only engages when a backlog EXISTS: an idle
+        dispatcher serves any deadline (the wave launches at once)."""
+        if self._draining:
+            self._shed("draining", nrows)
+        lim = self.admission_limit
+        if lim and self._queued_rows + nrows > lim:
+            self._shed("queue_full", nrows)
+        dl = deadline_s if deadline_s is not None \
+            else _REQUEST_DEADLINE.get()
+        if dl is not None and dl > 0 and self._queued_rows > 0:
+            # wait = draining what's AHEAD of this batch; its own
+            # service time is not queue wait
+            if self.projected_queue_wait_s(0) > dl:
+                self._shed("deadline", nrows)
+
+    def drain(self) -> None:
+        """Enter drain mode: queued/in-flight waves complete, new
+        ingress sheds with RESOURCE_EXHAUSTED('draining').  Part of the
+        daemon's graceful-shutdown sequence."""
+        self._draining = True
+
     def _submit(self, job) -> None:
         from .tracing import current_trace_id
 
+        self._fault("dispatch_enqueue")
+        n = _job_len(job)
+        self.admit(n)
         job.t_enq = self._clock()
         job.trace = current_trace_id()
         with self._submit_mu:
@@ -405,6 +548,7 @@ class Dispatcher:
             if self._closing.is_set():
                 raise RuntimeError("dispatcher is closed")
             self._queue.put(job)
+            self._queued_rows += n
 
     # ---- wave telemetry -------------------------------------------------
     #
@@ -668,6 +812,14 @@ class Dispatcher:
             # in-flight bound (GUBER_PIPELINE_DEPTH)
             "pipeline_depth": (self.pipeline_depth if self._pipelined
                                else 0),
+            # overload admission control (ISSUE 5): ingress bound,
+            # rows currently inside it, rows shed, drain state
+            "admission": {"limit_rows": self.admission_limit,
+                          "queued_rows": self._queued_rows,
+                          "shed_rows": self._shed_rows,
+                          "draining": self._draining,
+                          "projected_wait_s": round(
+                              self.projected_queue_wait_s(), 4)},
             "buffer_pool": (self.engine.wave_pool.stats()
                             if hasattr(self.engine, "wave_pool")
                             else None),
@@ -707,6 +859,14 @@ class Dispatcher:
 
     # ---- the merge loop -------------------------------------------------
 
+    def _dequeued(self, job) -> None:
+        """Admission accounting: the job left the ingress queue (its
+        rows now belong to a wave/carry, not the admission bound)."""
+        with self._submit_mu:
+            self._queued_rows -= _job_len(job)
+            if self._queued_rows < 0:  # defensive
+                self._queued_rows = 0
+
     def _drain_wave(self, block_s: float = 0.1) -> List[_Job]:
         """Block for one job (up to ``block_s``), then collect more for
         up to the coalescing window (GUBER_COALESCE_US, bounded by
@@ -723,12 +883,14 @@ class Dispatcher:
                          else self._queue.get_nowait())
             except queue.Empty:
                 return []
+            self._dequeued(first)
         wave = [first]
         total = _job_len(first)
         deadline = None  # armed only after the backlog is drained
         while total < self.max_wave:
             try:
                 job = self._queue.get_nowait()
+                self._dequeued(job)
             except queue.Empty:
                 if self.max_delay_s <= 0:
                     break
@@ -739,6 +901,7 @@ class Dispatcher:
                     break
                 try:
                     job = self._queue.get(timeout=remain)
+                    self._dequeued(job)
                 except queue.Empty:
                     break
             if total + _job_len(job) > self.max_wave:
@@ -842,6 +1005,7 @@ class Dispatcher:
         ``slot`` is its position in the in-flight ring at launch."""
         wid = self._wave_begin("packed_pipelined", jobs, slot=slot)
         try:
+            self._fault("dispatch_launch")
             if len(jobs) == 1:
                 batch, khash = jobs[0].batch, jobs[0].khash
             else:
@@ -849,6 +1013,7 @@ class Dispatcher:
                     [(j.batch, j.khash) for j in jobs])
             now = max(j.now_ms for j in jobs)
             with self._engine_lock:
+                self._fault("device_step")
                 token = self.engine.launch_packed(batch, khash, now)
             # the launch's host-side routing/fill IS pack work; device
             # time runs from here until sync_packed returns
@@ -863,6 +1028,7 @@ class Dispatcher:
 
     def _sync_and_resolve(self, jobs, token, wid, batch, khash) -> None:
         try:
+            self._fault("dispatch_sync")
             cols = self.engine.sync_packed(
                 token, engine_lock=self._engine_lock)
             self._wave_mark(wid, "device")
@@ -920,6 +1086,7 @@ class Dispatcher:
         now = max(j.now_ms for j in wave)
         self._wave_mark(wid, "pack")
         with self._engine_lock:
+            self._fault("device_step")
             st, lim, rem, rst, full = self.engine.check_packed(
                 batch, khash, now)
         self._wave_mark(wid, "device")
@@ -947,8 +1114,10 @@ class Dispatcher:
             slices.append((j, start, len(merged)))
         wid = self._wave_begin("list", jobs)
         try:
+            self._fault("dispatch_launch")
             self._wave_mark(wid, "pack")
             with self._engine_lock:
+                self._fault("device_step")
                 resps = self.engine.check_batch(merged, now)
             self._wave_mark(wid, "device")
             for j, a, b in slices:
@@ -976,8 +1145,10 @@ class Dispatcher:
             # scalar now only backstops sweeps/padding; requests use
             # their own now column.  max() keeps sweep time monotonic.
             now = max(j.now_ms for j in jobs)
+            self._fault("dispatch_launch")
             self._wave_mark(wid, "pack")
             with self._engine_lock:
+                self._fault("device_step")
                 cols = self.engine.check_packed(batch, khash, now)
             self._wave_mark(wid, "device")
             a = 0

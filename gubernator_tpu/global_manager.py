@@ -152,6 +152,43 @@ class GlobalManager:
 
         return req_from_tlv(tlv)
 
+    def _requeue_hits(self, entries) -> None:
+        """Put a FAILED flush's aggregates back into the queues
+        (ISSUE 5): degraded-mode hits reconcile EXACTLY once the owner
+        recovers, so an unreachable owner must requeue, not drop.
+        ``entries``: (key-or-khash, proto (req object or raw TLV),
+        accumulated hits, seq); merges with anything queued since the
+        flush popped them (latest-prototype-wins, sums preserved)."""
+        if not entries:
+            return
+        with self._mu:
+            for k, proto, acc, seq in entries:
+                if isinstance(proto, bytes):
+                    t0, a0, s0 = self._hits_raw.get(k, (proto, 0, 0))
+                    self._hits_raw[k] = (proto if seq >= s0 else t0,
+                                         a0 + acc, max(s0, seq))
+                else:
+                    p0, a0, s0 = self._hits.get(k, (proto, 0, 0))
+                    self._hits[k] = (proto if seq >= s0 else p0,
+                                     a0 + acc, max(s0, seq))
+            n = len(self._hits) + len(self._hits_raw)
+        self.metrics.queue_length.set(n)
+
+    def _fault_tick(self, point: str, stage: str) -> bool:
+        """Chaos hook for the async loops: True aborts this tick (the
+        queues were not popped yet, so nothing is lost)."""
+        f = getattr(self.instance, "faults", None)
+        if f is None or not f.armed:
+            return False
+        try:
+            f.fire(point)
+        except Exception as e:  # noqa: BLE001 - incl. FaultInjected
+            msg = f"{stage}: {exc_text(e)}"
+            log.warning(msg)
+            self._record([msg])
+            return True
+        return False
+
     # ---- async loops ---------------------------------------------------
 
     def _run_async_hits(self) -> None:
@@ -166,6 +203,8 @@ class GlobalManager:
         (pipelined flushes, retry, circuit fail-fast), aggregated per
         peer per window.  Non-default pickers / no codec keep the
         legacy object flush."""
+        if self._fault_tick("global_hits", "global hits flush"):
+            return
         with self._mu:
             hits, self._hits = self._hits, {}
             hits_raw, self._hits_raw = self._hits_raw, {}
@@ -189,9 +228,11 @@ class GlobalManager:
                              max(s0, seq))
         if not hits:
             return
-        # group by owner peer
-        by_owner: Dict[str, Tuple[object, List[RateLimitRequest]]] = {}
-        for key, (req, acc, _seq) in hits.items():
+        # group by owner peer; each entry keeps its requeue tuple so a
+        # failed chunk goes BACK on the queue instead of vanishing
+        by_owner: Dict[str, Tuple[object, List[RateLimitRequest],
+                                  List[tuple]]] = {}
+        for key, (req, acc, seq) in hits.items():
             if acc <= 0:
                 continue
             peer = self.instance.owner_of(key)
@@ -203,24 +244,30 @@ class GlobalManager:
                 algorithm=req.algorithm, behavior=req.behavior,
                 burst=req.burst)
             addr = peer.info.grpc_address
-            by_owner.setdefault(addr, (peer, []))[1].append(merged)
+            slot = by_owner.setdefault(addr, (peer, [], []))
+            slot[1].append(merged)
+            slot[2].append((key, req, acc, seq))
         errors = []
-        for addr, (peer, reqs) in by_owner.items():
-            try:
-                limit = self.behaviors.global_batch_limit
-                for i in range(0, len(reqs), limit):
+        for addr, (peer, reqs, entries) in by_owner.items():
+            limit = self.behaviors.global_batch_limit
+            for i in range(0, len(reqs), limit):
+                try:
                     peer.get_peer_rate_limits(
                         reqs[i:i + limit],
-                        timeout_s=self.behaviors.global_timeout_ms / 1000.0)
-            except Exception as e:  # noqa: BLE001 - next tick retries fresh
-                # exc_text: a peer deadline/TimeoutError str()s empty
-                errors.append(f"global hits sync to {addr}: "
-                              f"{exc_text(e)}")
-                self.metrics.check_error_counter.labels(
-                    error="global_hits_sync").inc()
-                log.warning(errors[-1])
-                self._record_event("error", stage="global_hits_sync",
-                                   error=errors[-1])
+                        timeout_s=self.behaviors.global_timeout_ms
+                        / 1000.0)
+                except Exception as e:  # noqa: BLE001 - requeue, next
+                    # tick retries (exact reconcile, ISSUE 5).
+                    # exc_text: a peer deadline/TimeoutError str()s empty
+                    self._requeue_hits(entries[i:])
+                    errors.append(f"global hits sync to {addr}: "
+                                  f"{exc_text(e)}")
+                    self.metrics.check_error_counter.labels(
+                        error="global_hits_sync").inc()
+                    log.warning(errors[-1])
+                    self._record_event("error", stage="global_hits_sync",
+                                       error=errors[-1])
+                    break
         self._record(errors)
 
     def _flush_hits_raw(self, hits, hits_raw) -> None:
@@ -240,8 +287,8 @@ class GlobalManager:
                 merged[kh] = (req if seq >= s0 else proto, a0 + acc,
                               max(s0, seq))
         inst = self.instance
-        by_owner: Dict[str, Tuple[object, List[bytes]]] = {}
-        for kh, (proto, acc, _seq) in merged.items():
+        by_owner: Dict[str, Tuple[object, List[bytes], List[tuple]]] = {}
+        for kh, (proto, acc, seq) in merged.items():
             if acc <= 0:
                 continue
             peer = inst.owner_by_raw_khash(kh)
@@ -255,25 +302,36 @@ class GlobalManager:
                        algorithm=proto.algorithm, behavior=proto.behavior,
                        burst=proto.burst)))
             addr = peer.info.grpc_address
-            by_owner.setdefault(addr, (peer, []))[1].append(tlv)
+            slot = by_owner.setdefault(addr, (peer, [], []))
+            slot[1].append(tlv)
+            # requeue tuple keyed the way it was queued: raw-lane
+            # protos under the raw khash, object-lane under the key
+            if isinstance(proto, bytes):
+                slot[2].append((kh, proto, acc, seq))
+            else:
+                slot[2].append((proto.key, proto, acc, seq))
         futs = []
         limit = self.behaviors.global_batch_limit
-        for addr, (peer, tlvs) in by_owner.items():
+        for addr, (peer, tlvs, entries) in by_owner.items():
             for i in range(0, len(tlvs), limit):
                 chunk = tlvs[i:i + limit]
+                ent = entries[i:i + limit]
                 try:
                     futs.append((addr, peer.forward_raw(
-                        b"".join(chunk), len(chunk))))
+                        b"".join(chunk), len(chunk)), ent))
                 except Exception as e:  # noqa: BLE001 - ErrCircuitOpen/
-                    # ErrClosing fail fast; next tick retries fresh
-                    futs.append((addr, _failed_future(e)))
+                    # ErrClosing fail fast; requeued below
+                    futs.append((addr, _failed_future(e), ent))
         errors = []
         deadline = time.monotonic() + \
             self.behaviors.global_timeout_ms / 1000.0 + 30.0
-        for addr, fut in futs:
+        for addr, fut, ent in futs:
             try:
                 fut.result(timeout=max(deadline - time.monotonic(), 0.1))
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 - requeue so the
+                # aggregates survive until the owner is reachable
+                # (exact reconcile, ISSUE 5)
+                self._requeue_hits(ent)
                 errors.append(f"global hits sync to {addr}: "
                               f"{exc_text(e)}")
                 self.metrics.check_error_counter.labels(
@@ -286,6 +344,8 @@ class GlobalManager:
     def _run_broadcasts(self) -> None:
         """Owner side: push merged authoritative state to all peers.
         reference: global.go › runBroadcasts → UpdatePeerGlobals."""
+        if self._fault_tick("global_broadcast", "global broadcast"):
+            return
         with self._mu:
             updates, self._updates = self._updates, {}
             updates_raw, self._updates_raw = self._updates_raw, {}
@@ -362,7 +422,14 @@ class GlobalManager:
                     log.warning(errors[-1])
         self._record(errors)
         self.metrics.global_broadcast_counter.inc()
-        self.metrics.broadcast_duration.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.metrics.broadcast_duration.observe(dt)
+        # per-phase attribution (closes the PR-4 ROADMAP open item):
+        # the broadcast path lands in the PhaseLedger / histogram next
+        # to ingest/device/peer_flush
+        disp = getattr(self.instance, "dispatcher", None)
+        if disp is not None:
+            disp._obs_phase("broadcast", dt)
         self._record_event("broadcast", keys=len(msgs), peers=len(peers),
                            errors=len(errors),
                            error=("; ".join(errors) or None))

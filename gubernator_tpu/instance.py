@@ -60,6 +60,23 @@ def clock_ms() -> int:
     return time.time_ns() // 1_000_000
 
 
+def _forward_fail_reason(e: Optional[BaseException]) -> str:
+    """Stable low-cardinality reason label for
+    gubernator_forward_failed (ISSUE 5 satellite)."""
+    from .peer_client import ErrCircuitOpen
+
+    if isinstance(e, ErrCircuitOpen):
+        return "circuit_open"
+    if isinstance(e, ErrClosing):
+        return "closing"
+    if isinstance(e, (TimeoutError,)) or \
+            type(e).__name__ == "TimeoutError":
+        return "timeout"
+    if isinstance(e, RuntimeError) and "short" in (str(e) or ""):
+        return "short_response"
+    return "rpc_error"
+
+
 class V1Instance:
     """One daemon's rate-limit brain: device engine + peer router."""
 
@@ -71,6 +88,14 @@ class V1Instance:
         #: stalls/timeouts, handover passes, GLOBAL broadcasts, errors —
         #: served as JSON at the daemon's GET /debug/events
         self.recorder = FlightRecorder()
+        # Fault injection (ISSUE 5, faults.py): per-instance named
+        # faultpoints, armed from GUBER_FAULT / POST /debug/faults.
+        # One attribute read per instrumented site while disarmed.
+        from .faults import FaultSet
+
+        self.faults = FaultSet.from_env()
+        self.faults.metrics = self.metrics
+        self.faults.recorder = self.recorder
         if engine is None:
             # lazy: an injected engine (tests, alternative backends)
             # must not drag the sharded/jax stack in
@@ -134,7 +159,8 @@ class V1Instance:
         self.dispatcher = Dispatcher(engine, lock=self._engine_mu,
                                      metrics=self.metrics,
                                      recorder=self.recorder,
-                                     analytics=analytics)
+                                     analytics=analytics,
+                                     faults=self.faults)
         # wave-buffer pool counters (hit/miss/leak) land on this
         # instance's registry; the pool lives engine-side (lease scope
         # is the engine's fill→launch window)
@@ -150,6 +176,18 @@ class V1Instance:
             self._picker = ReplicatedConsistentHash()
         self._peer_mu = threading.Lock()
         self._self_addr = config.advertise_address
+        # Health-gated routing ring (ISSUE 5): peers whose circuit has
+        # been open past peer_eject_after_ms are EJECTED from a derived
+        # routing picker (their keys deterministically rehome to the
+        # next ring point) and readmitted only after staying recovered
+        # for peer_readmit_after_ms.  All under _peer_mu.
+        self._gate_bad: frozenset = frozenset()
+        self._gate_picker = None
+        self._ring_gen = 0
+        #: IntervalLoop probing EJECTED peers (rehomed keys carry no
+        #: organic traffic, so nothing else would half-open their
+        #: circuit); started lazily on first ejection
+        self._probe_loop = None
         self.global_manager: Optional[GlobalManager] = None
         self.mr_manager: Optional[MultiRegionManager] = None
         self._gm_mu = threading.Lock()
@@ -178,21 +216,37 @@ class V1Instance:
     def _load_from_loader(self) -> None:
         from .store import arrays_from_items
 
+        self._fault_point("restore")
+        t0 = time.perf_counter()
         items = list(self.loader.load())
         if items:
             arrays = arrays_from_items(items)
             placed = self.engine.restore(arrays)
             log.info("loader: restored %d/%d items", placed, len(items))
+        # restore is a serving-blackout window — attribute it (ISSUE 5
+        # satellite; closes the PR-4 ROADMAP item with broadcast/
+        # snapshot)
+        self.dispatcher._obs_phase("restore", time.perf_counter() - t0)
 
     def _save_to_loader(self) -> None:
         from .store import items_from_arrays
 
         if self.loader is None:
             return
+        self._fault_point("snapshot")
+        t0 = time.perf_counter()
         # hot-set rows live outside the sharded table; fold them back in
         # so the snapshot is complete
         self._demote_all()
         self.loader.save(iter(items_from_arrays(self.engine.snapshot())))
+        self.dispatcher._obs_phase("snapshot", time.perf_counter() - t0)
+
+    def _fault_point(self, point: str, tag: Optional[str] = None) -> None:
+        """Instance-level faultpoint check (one attribute read while
+        disarmed — the acceptance A/B bound)."""
+        f = self.faults
+        if f.armed:
+            f.fire(point, tag)
 
     # ---- peer management (gubernator.go › SetPeers) --------------------
 
@@ -212,8 +266,16 @@ class V1Instance:
                     picker.add(PeerClient(info, self.config.behaviors,
                                           tls_creds=self._peer_tls,
                                           metrics=self.metrics,
-                                          analytics=self.analytics))
+                                          analytics=self.analytics,
+                                          faults=self.faults))
             self._picker = picker
+            # membership change invalidates the health-gated view —
+            # the next routing lookup re-derives it from live health
+            self._gate_bad = frozenset()
+            self._gate_picker = None
+            self._ring_gen += 1
+            self.metrics.ring_generation.set(self._ring_gen)
+            self.metrics.ring_ejected_peers.set(0)
         for departed in old.values():
             threading.Thread(target=departed.shutdown, daemon=True).start()
         # The hot-set psum tier is pod-local: once any non-self peer
@@ -262,8 +324,9 @@ class V1Instance:
         whatever is left.  Interim hits on the new owner between the
         picker swap and the upsert are overwritten — the same bounded
         window GLOBAL broadcasts already have."""
-        with self._peer_mu:
-            picker = self._picker
+        # route by the health-gated ring: a handover triggered by an
+        # ejection/readmit must target where requests actually go
+        picker = self._routing_picker()
         if not self._uses_default_hash(picker) or (
                 old_picker.peers()
                 and not self._uses_default_hash(old_picker)):
@@ -418,6 +481,120 @@ class V1Instance:
     def is_self(self, peer: PeerClient) -> bool:
         return peer.info.grpc_address == self._self_addr
 
+    # ---- health-gated routing ring (ISSUE 5) ---------------------------
+
+    def _routing_picker(self):
+        """The picker requests ROUTE by: the membership picker with
+        long-unhealthy peers ejected (their keys deterministically
+        rehome to the next ring point — exactly the picker that would
+        exist without them) and readmitted after the hysteresis window.
+        The membership picker itself stays authoritative for reconcile
+        targets (owner_of / owner_by_raw_khash), so degraded hits always
+        flush to the TRUE owner once it is reachable.
+
+        Healthy cluster fast path: one lock + one health read per peer,
+        returning the membership picker itself."""
+        b = self.config.behaviors
+        if not getattr(b, "peer_health_gate", True):
+            with self._peer_mu:
+                return self._picker
+        eject_s = max(int(getattr(b, "peer_eject_after_ms", 3000)),
+                      0) / 1e3
+        readmit_s = max(int(getattr(b, "peer_readmit_after_ms", 3000)),
+                        0) / 1e3
+        with self._peer_mu:
+            picker = self._picker
+            peers = picker.peers()
+            if not peers:
+                return picker
+            bad = frozenset(
+                p.info.grpc_address for p in peers
+                if not self.is_self(p) and hasattr(p, "route_healthy")
+                and not p.route_healthy(eject_s, readmit_s))
+            if len(bad) >= len(peers):
+                # never empty the ring: with every peer unhealthy the
+                # membership ring is the least-wrong answer
+                bad = frozenset()
+            if bad == self._gate_bad:
+                return (self._gate_picker
+                        if self._gate_picker is not None else picker)
+            old_bad = self._gate_bad
+            old_routing = (self._gate_picker
+                           if self._gate_picker is not None else picker)
+            gated = None
+            if bad:
+                gated = picker.new()
+                for p in peers:
+                    if p.info.grpc_address not in bad:
+                        gated.add(p)
+            self._gate_bad = bad
+            self._gate_picker = gated
+            self._ring_gen += 1
+            gen = self._ring_gen
+        # emission + probe/handover management OFF the lock
+        self.metrics.ring_generation.set(gen)
+        self.metrics.ring_ejected_peers.set(len(bad))
+        for addr in sorted(bad - old_bad):
+            log.warning("ring: peer %s EJECTED from routing (circuit "
+                        "open > %.1fs); its keys rehome until readmit",
+                        addr, eject_s)
+            self.recorder.record("ring_ejected", peer=addr,
+                                 generation=gen)
+        for addr in sorted(old_bad - bad):
+            log.info("ring: peer %s readmitted to routing "
+                     "(recovered > %.1fs)", addr, readmit_s)
+            self.recorder.record("ring_readmitted", peer=addr,
+                                 generation=gen)
+        if bad:
+            self._ensure_probe_loop()
+        if self.config.handover_on_reshard:
+            # keys moved between live daemons: reuse the stateful
+            # rehome machinery so consumption follows them (best
+            # effort — an ejected target just keeps its rows)
+            with self._handover_gen_mu:
+                self._handover_gen += 1
+                hgen = self._handover_gen
+            threading.Thread(target=self._handover_moved_rows,
+                             args=(old_routing, hgen),
+                             daemon=True).start()
+        return gated if gated is not None else picker
+
+    def _route_owner_of(self, key: str) -> Optional[PeerClient]:
+        """owner_of through the health-gated ring (the forward path's
+        view); reconcile/broadcast targets keep using owner_of."""
+        picker = self._routing_picker()
+        if not picker.peers():
+            return None
+        return picker.get(key)
+
+    def _ensure_probe_loop(self) -> None:
+        with self._gm_mu:
+            if self._probe_loop is None and not self._closed:
+                from .interval import IntervalLoop
+
+                iv = max(int(getattr(self.config.behaviors,
+                                     "peer_circuit_cooldown_ms", 2000)),
+                         100)
+                self._probe_loop = IntervalLoop(
+                    iv, self._probe_ejected, name="ring-health-probe")
+
+    def _probe_ejected(self) -> None:
+        """Probe every EJECTED peer with one empty flush so a recovered
+        peer's circuit can close (rehomed keys generate no organic
+        traffic toward it).  Failures keep the circuit open — that is
+        the point."""
+        with self._peer_mu:
+            bad = self._gate_bad
+            peers = list(self._picker.peers())
+        if not bad:
+            return
+        for p in peers:
+            if p.info.grpc_address in bad and hasattr(p, "probe"):
+                try:
+                    p.probe()
+                except Exception:  # noqa: BLE001 - probe is best-effort
+                    pass
+
     def _ensure_global_manager(self) -> GlobalManager:
         with self._gm_mu:
             if self.global_manager is None:
@@ -452,6 +629,9 @@ class V1Instance:
             raise ValueError(
                 f"Requests.RateLimits list too large; max size is "
                 f"{MAX_BATCH_SIZE}")
+        # overload admission (ISSUE 5): shed cheaply at ingest, before
+        # any engine work (raises ResourceExhausted → RESOURCE_EXHAUSTED)
+        self.dispatcher.admit(len(reqs))
         now = clock_ms() if now_ms is None else now_ms
         self.metrics.getratelimit_counter.labels(calltype="api").inc(len(reqs))
         self.metrics.concurrent_checks.inc()
@@ -484,6 +664,7 @@ class V1Instance:
         pb2 object path with identical semantics.  Raises ValueError
         on oversize batches (mirroring ``get_rate_limits``).
         """
+        self._fault_point("wire_ingest")
         parsed = None
         is_global = False
         clustered = False
@@ -562,6 +743,7 @@ class V1Instance:
                 else:
                     runner = inner
             if runner is not None:
+                self.dispatcher.admit(n)
                 self.metrics.getratelimit_counter.labels(
                     calltype="api").inc(n)
                 self.metrics.wire_lane_counter.labels(lane=lane).inc(n)
@@ -625,6 +807,11 @@ class V1Instance:
             raise ValueError(
                 f"Requests.RateLimits list too large; max size is "
                 f"{MAX_BATCH_SIZE}")
+        try:
+            self.dispatcher.admit(pre.n)
+        except BaseException:
+            pre.lease.release()
+            raise
         self.metrics.getratelimit_counter.labels(calltype="api").inc(
             pre.n)
         self.metrics.wire_lane_counter.labels(lane="wire_local").inc(
@@ -744,11 +931,20 @@ class V1Instance:
         aggregated per unique key with raw TLV prototypes — the
         columnar twins of the per-request queueing the object path
         does."""
+        self._fault_point("wire_ingest")
         parsed = None
+        # rehome-target duty (ISSUE 5): while OUR health gate has peers
+        # ejected, a forwarded row whose membership owner is ejected is
+        # a rehomed row another daemon routed here — it must serve
+        # DEGRADED (flag + reconcile queue), which needs parsed columns;
+        # healthy gate (the steady state) costs one attribute read
+        gate_rehome = bool(self._gate_bad) and getattr(
+            self.config.behaviors, "peer_degraded_fallback", True)
         if _wire_native is not None and self.store is None:
-            out = self._wire_peer_fused(data, now_ms)
-            if out is not None:
-                return out
+            if not gate_rehome:
+                out = self._wire_peer_fused(data, now_ms)
+                if out is not None:
+                    return out
             t_ing = time.perf_counter()
             parsed = _wire_native.parse_get_rate_limits(data)
             if parsed is not None:
@@ -792,7 +988,73 @@ class V1Instance:
             mr = (parsed["behavior"]
                   & int(Behavior.MULTI_REGION)) != 0
             self._queue_mr_raw(parsed, data, mr)
+        if gate_rehome:
+            out = self._peer_degraded_rewrite(parsed, data, out)
         return out
+
+    def _peer_degraded_rewrite(self, parsed: dict, data: bytes,
+                               out: bytes) -> bytes:
+        """Rehome-target side of degraded mode (ISSUE 5): a forwarded
+        row whose MEMBERSHIP owner is ejected from our health gate was
+        routed here by another daemon's gated ring.  Its local apply
+        (already done by the caller) is a DEGRADED serve: flag the
+        response row and queue the hits for reconcile to the true
+        owner, exactly like a rehomed row on the client path — without
+        this, hits forwarded to a rehome target would be silently
+        absorbed into its shard and conservation would break.  Only
+        runs while our gate has ejected peers (``gate_rehome``)."""
+        bad = self._gate_bad
+        with self._peer_mu:
+            mpick = self._picker
+        if not bad or not mpick.peers() \
+                or not self._uses_default_hash(mpick):
+            return out
+        peers_l = mpick.owner_peers()
+        bad_pi = [pi for pi, p in enumerate(peers_l)
+                  if p.info.grpc_address in bad]
+        if not bad_pi:
+            return out
+        from .hashing import mix64_np
+
+        raw = mix64_np(parsed["khash_raw"])
+        owners = mpick.owner_indices(raw)
+        # GLOBAL rows excluded alongside the state-mutating behaviors:
+        # as acting owner we queue their broadcast state already —
+        # degrading them too would double-queue the hits
+        mask = (np.isin(owners, bad_pi)
+                & ((parsed["behavior"]
+                    & int(self._DEGRADED_EXCLUDED
+                          | Behavior.GLOBAL)) == 0))
+        if not mask.any():
+            return out
+        gm = self._ensure_global_manager()
+        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask):
+            gm.queue_hits_raw(k, tlv, a)
+        # flag the masked rows: re-serialize just those items with the
+        # degraded metadata (pb2 — metadata has no C++ lane; this path
+        # only runs mid-outage)
+        ro, rl, _rs = _wire_native.split_resp_items(out)
+        items: List[bytes] = []
+        by_addr: Dict[str, int] = {}
+        for j in range(parsed["n"]):
+            tlv = out[int(ro[j]):int(ro[j] + rl[j])]
+            if mask[j]:
+                m = pb.GetRateLimitsResp.FromString(tlv)
+                r = m.responses[0]
+                if not r.error:
+                    addr = peers_l[int(owners[j])].info.grpc_address
+                    r.metadata["degraded"] = "true"
+                    r.metadata["degraded_peer"] = addr
+                    by_addr[addr] = by_addr.get(addr, 0) + 1
+                    tlv = m.SerializeToString()
+            items.append(tlv)
+        for addr, cnt in by_addr.items():
+            self.metrics.degraded_served.labels(peer_addr=addr).inc(cnt)
+        if by_addr:
+            self.recorder.record("degraded", peer=min(by_addr),
+                                 rows=sum(by_addr.values()),
+                                 rehomed=True)
+        return b"".join(items)
 
     @staticmethod
     def _raw_queue_groups(parsed: dict, data: bytes, mask: np.ndarray):
@@ -1032,15 +1294,35 @@ class V1Instance:
         n = parsed["n"]
         raw = mix64_np(parsed["khash_raw"])
         with self._peer_mu:
-            picker = self._picker
-            peer_list = picker.owner_peers()
-            # pre-zero-remap, matching picker.get(key)'s hash pipeline
-            owners = picker.owner_indices(raw)
+            membership = self._picker
+        # route by the health-gated ring (ISSUE 5): long-dead owners
+        # are ejected and their keys rehome; healthy clusters get the
+        # membership picker itself back (pickers are immutable, so the
+        # lookups below run lock-free)
+        picker = self._routing_picker()
+        peer_list = picker.owner_peers()
+        # pre-zero-remap, matching picker.get(key)'s hash pipeline
+        owners = picker.owner_indices(raw)
         kh = np.where(raw == 0, np.uint64(1), raw)
         toff, tlen = parsed["tlv_off"], parsed["tlv_len"]
 
         self_pi = [pi for pi, p in enumerate(peer_list) if self.is_self(p)]
         local_mask = np.isin(owners, self_pi)
+        # rows rehomed to us by an ejection are DEGRADED serves: answer
+        # locally, flag the response, and queue the hits for reconcile
+        # to the membership owner — never silently authoritative
+        deg_mask = _NO_ROWS
+        m_owners = m_peers = None
+        if (picker is not membership and membership.peers()
+                and getattr(self.config.behaviors,
+                            "peer_degraded_fallback", True)):
+            m_peers = membership.owner_peers()
+            m_owners = membership.owner_indices(raw)
+            m_self = [pi for pi, p in enumerate(m_peers)
+                      if self.is_self(p)]
+            deg_mask = (local_mask & ~np.isin(m_owners, m_self)
+                        & ((parsed["behavior"]
+                            & int(self._DEGRADED_EXCLUDED)) == 0))
         # behavior_or gates the column scan: GLOBAL-free batches (the
         # common clustered shape) pay nothing here
         if parsed["behavior_or"] & int(Behavior.GLOBAL):
@@ -1064,6 +1346,11 @@ class V1Instance:
                 glob_queue.append(
                     (k, tlv, a, int(owners[i]) in self_pi))
             local_mask = local_mask | glob_mask
+            if deg_mask.size:
+                # GLOBAL rows already answer from the replica with
+                # their own reconcile queues — degrading them too would
+                # double-queue the hits
+                deg_mask = deg_mask & ~glob_mask
         item_tlvs: List[Optional[bytes]] = [None] * n
 
         # fire remote forwards first so the local device step overlaps:
@@ -1087,12 +1374,30 @@ class V1Instance:
             try:
                 fut = peer_list[int(pi)].forward_raw(sub, int(idxs.size))
             except Exception as e:  # noqa: BLE001 - incl. ErrClosing /
-                # ErrCircuitOpen (fail-fast: per-request error rows for
-                # this sub-batch only, the object path's semantics)
+                # ErrCircuitOpen (fail-fast: degraded local answers —
+                # or per-request error rows — for this sub-batch only)
                 send_err = e
-            groups.append((idxs, fut, send_err))
+            groups.append((idxs, fut, send_err,
+                           peer_list[int(pi)].info.grpc_address))
 
         over = 0  # remote OVER_LIMITs (the local step counts its own)
+        # rehomed rows serve DEGRADED (flag + reconcile queue), apart
+        # from the normal local step
+        if deg_mask.size and deg_mask.any():
+            for pi in np.unique(m_owners[deg_mask]):
+                didx = np.nonzero(deg_mask & (m_owners == pi))[0]
+                addr = m_peers[int(pi)].info.grpc_address
+                try:
+                    tlvs = self._serve_degraded_wire(
+                        parsed, data, didx, kh, now, addr)
+                    for j, i in enumerate(didx):
+                        item_tlvs[int(i)] = tlvs[j]
+                except Exception as e:  # noqa: BLE001 - degraded serve
+                    # must never take the whole batch down
+                    log.warning("degraded serve for %d rehomed rows "
+                                "(owner %s) failed: %s", didx.size,
+                                addr, exc_text(e))
+            local_mask = local_mask & ~deg_mask
         local_idx = np.nonzero(local_mask)[0]
         if local_idx.size:
             lbytes = self._packed_check_to_bytes(
@@ -1133,7 +1438,7 @@ class V1Instance:
                     * (b.batch_timeout_ms / 1000.0 + 60.0)
                     + b.peer_retry_limit * b.peer_retry_backoff_ms
                     / 1000.0 + 5.0)
-        for idxs, fut, send_err in groups:
+        for idxs, fut, send_err, addr in groups:
             rbytes, err, sp = None, send_err, None
             if fut is not None:
                 try:
@@ -1149,17 +1454,26 @@ class V1Instance:
             if sp is None:
                 self.metrics.check_error_counter.labels(
                     error="peer_forward").inc(int(idxs.size))
-                z32 = np.zeros(idxs.size, np.int32)
-                z64 = np.zeros(idxs.size, np.int64)
-                # exc_text: a grpc deadline/TimeoutError str()s empty —
-                # the row must stay diagnosable (round-5 bug, repo-wide)
-                ebytes = _wire_native.build_rate_limit_resps(
-                    z32, z64, z64, z64,
-                    [f"while fetching rate limit from peer: "
-                     f"{exc_text(err)}"] * int(idxs.size))
-                eo, el, _ = _wire_native.split_resp_items(ebytes)
-                for j, i in enumerate(idxs):
-                    item_tlvs[int(i)] = ebytes[int(eo[j]):int(eo[j] + el[j])]
+                self.metrics.forward_failed.labels(
+                    peer_addr=addr,
+                    reason=_forward_fail_reason(err)).inc(int(idxs.size))
+                served = self._degrade_failed_forward(
+                    parsed, data, idxs, kh, now, addr, item_tlvs)
+                rest = idxs[~served]
+                if rest.size:
+                    z32 = np.zeros(rest.size, np.int32)
+                    z64 = np.zeros(rest.size, np.int64)
+                    # exc_text: a grpc deadline/TimeoutError str()s
+                    # empty — the row must stay diagnosable; the peer
+                    # address attributes WHICH owner failed
+                    ebytes = _wire_native.build_rate_limit_resps(
+                        z32, z64, z64, z64,
+                        [f"while fetching rate limit from peer {addr}: "
+                         f"{exc_text(err)}"] * int(rest.size))
+                    eo, el, _ = _wire_native.split_resp_items(ebytes)
+                    for j, i in enumerate(rest):
+                        item_tlvs[int(i)] = \
+                            ebytes[int(eo[j]):int(eo[j] + el[j])]
                 continue
             ro, rl, rs = sp
             over += int((rs == 1).sum())
@@ -1167,7 +1481,106 @@ class V1Instance:
                 item_tlvs[int(i)] = rbytes[int(ro[j]):int(ro[j] + rl[j])]
 
         self.metrics.over_limit_counter.inc(over)
+        if any(t is None for t in item_tlvs):
+            # belt: a failed degraded serve must still answer its rows
+            miss = [i for i, t in enumerate(item_tlvs) if t is None]
+            z32 = np.zeros(len(miss), np.int32)
+            z64 = np.zeros(len(miss), np.int64)
+            ebytes = _wire_native.build_rate_limit_resps(
+                z32, z64, z64, z64,
+                ["degraded-mode serve failed"] * len(miss))
+            eo, el, _ = _wire_native.split_resp_items(ebytes)
+            for j, i in enumerate(miss):
+                item_tlvs[i] = ebytes[int(eo[j]):int(eo[j] + el[j])]
         return b"".join(item_tlvs)
+
+    # ---- degraded-mode owner fallback (ISSUE 5) ------------------------
+
+    #: behaviors that must NOT be served from a non-authoritative row:
+    #: RESET/DRAIN mutate state the reconcile queue cannot carry, and
+    #: MULTI_REGION replication must originate from the region owner
+    _DEGRADED_EXCLUDED = (Behavior.RESET_REMAINING
+                          | Behavior.DRAIN_OVER_LIMIT
+                          | Behavior.MULTI_REGION)
+
+    def _serve_degraded_wire(self, parsed: dict, data: bytes,
+                             idxs: np.ndarray, kh: np.ndarray, now: int,
+                             peer_addr: str) -> List[bytes]:
+        """Answer ``idxs`` from the LOCAL shard in degraded mode: one
+        device step over the sub-batch, responses flagged with
+        ``metadata.degraded`` (pb2-built — the C++ response builder has
+        no metadata lane, and degraded serving is off the happy path by
+        definition), and the hits queued per unique key into the GLOBAL
+        hit-flush queues for reconcile to the owner — bounded staleness
+        instead of unavailability.  Returns one response TLV per row of
+        ``idxs``."""
+        from .core.batch import pack_columns
+        from .wire import _varint
+
+        m = int(idxs.size)
+        batch, errs = pack_columns(
+            kh[idxs], parsed["hits"][idxs], parsed["limit"][idxs],
+            parsed["duration"][idxs], parsed["algorithm"][idxs],
+            parsed["behavior"][idxs], parsed["burst"][idxs], now)
+        view = self.dispatcher.check_packed_view(batch, kh[idxs], now)
+        st, lim, rem, rst, full = view.sliced()
+        self.metrics.over_limit_counter.inc(int((st == 1).sum()))
+        out: List[bytes] = []
+        for j in range(m):
+            msg = pb.RateLimitResp(
+                status=int(st[j]), limit=int(lim[j]),
+                remaining=int(rem[j]), reset_time=int(rst[j]))
+            if errs and j in errs:
+                msg.error = errs[j]
+            elif bool(full[j]):
+                msg.error = "rate limit table full"
+            else:
+                msg.metadata["degraded"] = "true"
+                msg.metadata["degraded_peer"] = peer_addr
+            payload = msg.SerializeToString()
+            out.append(b"\x0a" + _varint(len(payload)) + payload)
+        # reconcile-on-recovery: aggregate this sub-batch's hits per
+        # unique key into the raw hit queue (the owner applies them
+        # once reachable; failed flushes requeue — global_manager.py)
+        mask = np.zeros(parsed["n"], bool)
+        mask[idxs] = True
+        gm = self._ensure_global_manager()
+        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask):
+            gm.queue_hits_raw(k, tlv, a)
+        self.metrics.degraded_served.labels(peer_addr=peer_addr).inc(m)
+        self.recorder.record("degraded", peer=peer_addr, rows=m)
+        return out
+
+    def _degrade_failed_forward(self, parsed: dict, data: bytes,
+                                idxs: np.ndarray, kh: np.ndarray,
+                                now: int, addr: str,
+                                item_tlvs: List[Optional[bytes]]
+                                ) -> np.ndarray:
+        """Failed-forward fallback: serve the eligible rows of a failed
+        sub-batch degraded (writes into ``item_tlvs``); returns the
+        boolean mask (aligned with ``idxs``) of rows served.  Rows with
+        excluded behaviors — or everything, when the fallback is
+        disabled — stay unserved for the caller's error rows."""
+        served = np.zeros(int(idxs.size), bool)
+        if not getattr(self.config.behaviors,
+                       "peer_degraded_fallback", True):
+            return served
+        elig = (parsed["behavior"][idxs]
+                & int(self._DEGRADED_EXCLUDED)) == 0
+        if not elig.any():
+            return served
+        sub = idxs[elig]
+        try:
+            tlvs = self._serve_degraded_wire(parsed, data, sub, kh,
+                                             now, addr)
+        except Exception as e:  # noqa: BLE001 - fall back to error rows
+            log.warning("degraded serve for %d rows (owner %s) "
+                        "failed: %s", sub.size, addr, exc_text(e))
+            return served
+        for j, i in enumerate(sub):
+            item_tlvs[int(i)] = tlvs[j]
+        served[elig] = True
+        return served
 
     def _get_rate_limits(self, reqs, now) -> List[RateLimitResponse]:
         n = len(reqs)
@@ -1179,11 +1592,20 @@ class V1Instance:
 
         have_peers = bool(self.peers())
         glob_q: List[tuple] = []  # (req, we_are_owner), queued post-step
+        # routing picker hoisted out of the hot loop (health-gated
+        # ring, ISSUE 5); membership picker alongside so rehomed rows
+        # are recognized as DEGRADED serves, not silently authoritative
+        rpick = self._routing_picker() if have_peers else None
+        with self._peer_mu:
+            mpick = self._picker
+        gate_active = have_peers and rpick is not mpick
+        deg_local: List[tuple] = []  # (idx, membership owner addr)
         # hot loop: plain-int flag tests (IntFlag.__and__ costs ~µs each
         # and this loop runs per request)
         GLOBAL = int(Behavior.GLOBAL)
         MULTI_REGION = int(Behavior.MULTI_REGION)
         NO_BATCHING = int(Behavior.NO_BATCHING)
+        DEGRADED_EXCL = int(self._DEGRADED_EXCLUDED)
         for i, req in enumerate(reqs):
             if not req.unique_key:
                 responses[i] = RateLimitResponse(
@@ -1219,9 +1641,23 @@ class V1Instance:
                 if behavior & MULTI_REGION:
                     self._ensure_mr_manager().queue_hits(req)
                 continue
-            owner = self.owner_of(req.key)
+            try:
+                owner = rpick.get(req.key) if rpick.peers() else None
+            except RuntimeError:
+                owner = None
             if owner is None or self.is_self(owner):
                 local_idx.append(i)
+                if gate_active and not (behavior & DEGRADED_EXCL):
+                    # rehomed to us by an ejection? serve DEGRADED:
+                    # flag the response and reconcile the hits to the
+                    # membership owner once it is back
+                    try:
+                        mowner = (mpick.get(req.key)
+                                  if mpick.peers() else None)
+                    except RuntimeError:
+                        mowner = None
+                    if mowner is not None and not self.is_self(mowner):
+                        deg_local.append((i, mowner.info.grpc_address))
                 # local-region owner replicates cross-DC asynchronously
                 if behavior & MULTI_REGION:
                     self._ensure_mr_manager().queue_hits(req)
@@ -1229,7 +1665,7 @@ class V1Instance:
                 fwd.append((i, owner, req))
 
         # forwards first (async futures), so the device step overlaps RPCs
-        futures: List[tuple[int, Future]] = []
+        futures: List[tuple] = []
         for i, peer, req in fwd:
             if int(req.behavior) & NO_BATCHING:
                 f: Future = Future()
@@ -1247,7 +1683,7 @@ class V1Instance:
                 except Exception as e:  # noqa: BLE001 - incl. ErrClosing
                     f = Future()
                     f.set_exception(e)
-            futures.append((i, f))
+            futures.append((i, f, peer.info.grpc_address, req))
 
         if hot:
             hot_reqs = [reqs[i] for i, _ in hot]
@@ -1272,6 +1708,17 @@ class V1Instance:
             self._after_local(
                 [reqs[i] for i in local_idx],
                 [responses[i] for i in local_idx])
+        if deg_local:
+            gm = self._ensure_global_manager()
+            for i, addr in deg_local:
+                resp = responses[i]
+                if resp is None or resp.error:
+                    continue
+                resp.metadata["degraded"] = "true"
+                resp.metadata["degraded_peer"] = addr
+                gm.queue_hits(reqs[i])
+                self.metrics.degraded_served.labels(
+                    peer_addr=addr).inc()
         if glob_q:
             gm = self._ensure_global_manager()
             for req, own in glob_q:
@@ -1284,7 +1731,10 @@ class V1Instance:
 
         timeout = (self.config.behaviors.batch_timeout_ms
                    + self.config.behaviors.batch_wait_ms) / 1000.0 + 30.0
-        for i, f in futures:
+        deg_ok = getattr(self.config.behaviors,
+                         "peer_degraded_fallback", True)
+        deg_failed: List[tuple] = []  # (idx, req, owner addr)
+        for i, f, addr, req in futures:
             try:
                 responses[i] = f.result(timeout=timeout)
                 if responses[i].status == Status.OVER_LIMIT:
@@ -1292,9 +1742,43 @@ class V1Instance:
             except Exception as e:  # noqa: BLE001
                 self.metrics.check_error_counter.labels(
                     error="peer_forward").inc()
-                responses[i] = RateLimitResponse(
-                    error=f"while fetching rate limit from peer: "
-                          f"{exc_text(e)}")
+                self.metrics.forward_failed.labels(
+                    peer_addr=addr,
+                    reason=_forward_fail_reason(e)).inc()
+                if deg_ok and not (int(req.behavior) & DEGRADED_EXCL):
+                    deg_failed.append((i, req, addr))
+                else:
+                    responses[i] = RateLimitResponse(
+                        error=f"while fetching rate limit from peer "
+                              f"{addr}: {exc_text(e)}")
+        if deg_failed:
+            # degraded-mode owner fallback (ISSUE 5): answer the failed
+            # forwards from the local shard, flag them, and reconcile
+            # the hits through the GLOBAL hit-flush queues
+            try:
+                dresps = self.dispatcher.check_batch(
+                    [req for _, req, _ in deg_failed], now)
+                gm = self._ensure_global_manager()
+                for (i, req, addr), resp in zip(deg_failed, dresps):
+                    if not resp.error:
+                        resp.metadata["degraded"] = "true"
+                        resp.metadata["degraded_peer"] = addr
+                        gm.queue_hits(req)
+                        self.metrics.degraded_served.labels(
+                            peer_addr=addr).inc()
+                        if resp.status == Status.OVER_LIMIT:
+                            self.metrics.over_limit_counter.inc()
+                    responses[i] = resp
+                self.recorder.record("degraded",
+                                     peer=deg_failed[0][2],
+                                     rows=len(deg_failed))
+            except Exception as e:  # noqa: BLE001 - degraded serve must
+                # never take the batch down; fall back to error rows
+                for i, req, addr in deg_failed:
+                    if responses[i] is None:
+                        responses[i] = RateLimitResponse(
+                            error=f"while fetching rate limit from "
+                                  f"peer {addr}: {exc_text(e)}")
         self._maybe_sweep(now)
         return responses  # type: ignore[return-value]
 
@@ -1509,8 +1993,38 @@ class V1Instance:
             if req.behavior & Behavior.MULTI_REGION:
                 # we are the local-region owner for this forwarded key
                 self._ensure_mr_manager().queue_hits(req)
+        # rehome-target duty (ISSUE 5, object-path twin of
+        # _peer_degraded_rewrite): rows whose membership owner is
+        # ejected from OUR gate were rehomed here — flag + reconcile
+        if self._gate_bad and getattr(self.config.behaviors,
+                                      "peer_degraded_fallback", True):
+            self._peer_degraded_objects(reqs, resps)
         self._after_local(reqs, resps)
         return resps
+
+    def _peer_degraded_objects(self, reqs, resps) -> None:
+        bad = self._gate_bad
+        with self._peer_mu:
+            mpick = self._picker
+        if not bad or not mpick.peers():
+            return
+        gm = None
+        excl = int(self._DEGRADED_EXCLUDED | Behavior.GLOBAL)
+        for req, resp in zip(reqs, resps):
+            if resp.error or (int(req.behavior) & excl):
+                continue
+            try:
+                owner = mpick.get(req.key)
+            except RuntimeError:
+                return
+            addr = owner.info.grpc_address
+            if addr not in bad or self.is_self(owner):
+                continue
+            resp.metadata["degraded"] = "true"
+            resp.metadata["degraded_peer"] = addr
+            gm = gm or self._ensure_global_manager()
+            gm.queue_hits(req)
+            self.metrics.degraded_served.labels(peer_addr=addr).inc()
 
     # ---- GLOBAL broadcast plumbing -------------------------------------
 
@@ -1683,6 +2197,8 @@ class V1Instance:
             self.mr_manager.close()
         if self._hot_sync_loop is not None:
             self._hot_sync_loop.close()
+        if self._probe_loop is not None:
+            self._probe_loop.close()
         self.dispatcher.close()
         if self.dispatcher.analytics is not None:
             self.dispatcher.analytics.close()

@@ -218,6 +218,48 @@ class Metrics:
             "wave taps dropped because the analytics queue was full "
             "(analytics never applies backpressure to serving)",
             registry=r)
+        # Failure-domain resilience (ISSUE 5): degraded-mode serving,
+        # health-gated ring churn, admission shedding, and fault
+        # injection all need first-class visibility — a cluster riding
+        # out a dead owner must LOOK like one on /metrics.
+        self.forward_failed = Counter(
+            "gubernator_forward_failed",
+            "forwarded sub-batches that failed, by peer and reason "
+            "(circuit_open, closing, rpc_error, short_response, "
+            "send_error) — counts requests, whether they degraded to "
+            "local answers or became error rows",
+            ["peer_addr", "reason"], registry=r)
+        self.degraded_served = Counter(
+            "gubernator_degraded_served",
+            "requests answered locally in degraded mode while their "
+            "owner was unreachable or their keys were rehomed "
+            "(response carries metadata degraded=true; hits reconcile "
+            "to the owner through the GLOBAL hit-flush queues)",
+            ["peer_addr"], registry=r)
+        self.ring_generation = Gauge(
+            "gubernator_ring_generation",
+            "monotonic generation of the health-gated routing ring; "
+            "bumps when a peer is ejected or readmitted (flap detector: "
+            "one outage should cost exactly two bumps)", registry=r)
+        self.ring_ejected_peers = Gauge(
+            "gubernator_ring_ejected_peers",
+            "peers currently ejected from the routing ring by the "
+            "health gate (their keys are rehomed until readmit)",
+            registry=r)
+        self.admission_shed = Counter(
+            "gubernator_admission_shed",
+            "requests shed at ingress with RESOURCE_EXHAUSTED, by "
+            "reason (queue_full, deadline, draining)",
+            ["reason"], registry=r)
+        self.draining = Gauge(
+            "gubernator_draining",
+            "1 while the daemon is in its shutdown drain window "
+            "(shallow /healthz returns 503 'draining')", registry=r)
+        self.fault_injected = Counter(
+            "gubernator_fault_injected",
+            "times an armed faultpoint fired (faults.py; 0 in healthy "
+            "operation — nonzero means a chaos run is active)",
+            ["point"], registry=r)
 
     @contextmanager
     def time_func(self, name: str):

@@ -223,6 +223,9 @@ class _SendLane:
             return
         t0 = time.perf_counter()
         try:
+            # faultpoint: a chaos run failing/delaying this peer's
+            # sends lands here — same handling as a real dial failure
+            client._fault("peer_send")
             call = client._raw_call(self.method)
             tp = next((e.trace for e in entries if e.trace), None)
             md = ([("traceparent", tp)] if tp else outbound_metadata())
@@ -252,6 +255,9 @@ class _SendLane:
                 peer_addr=self.client.info.grpc_address).dec()
         try:
             rbytes = f.result()
+            # faultpoint: lose/delay the response after the RPC
+            # succeeded (tests the retry path's idempotence)
+            self.client._fault("peer_recv")
         except Exception as e:  # noqa: BLE001 - RpcError et al.
             self._on_done(None, entries, data, attempt, t0, err=e)
             return
@@ -366,7 +372,7 @@ class PeerClient:
 
     def __init__(self, info: PeerInfo, behaviors: BehaviorConfig,
                  tls_creds: Optional[grpc.ChannelCredentials] = None,
-                 metrics=None, analytics=None):
+                 metrics=None, analytics=None, faults=None):
         self.info = info
         self.behaviors = behaviors
         self._tls = tls_creds
@@ -374,6 +380,9 @@ class PeerClient:
         #: optional KeyAnalytics: flush round-trips feed the
         #: "peer_flush" phase of the latency ledger (ISSUE 4)
         self._analytics = analytics
+        #: optional FaultSet (faults.py): peer_send / peer_recv /
+        #: peer_circuit faultpoints, tagged with this peer's address
+        self._faults = faults
         self._channel: Optional[grpc.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
         self._raw_calls: dict = {}  # method → bytes-lane call handle
@@ -389,6 +398,14 @@ class PeerClient:
         self._consec_failures = 0
         self._open_until = 0.0
         self._circuit_opens = 0
+        # routing-health hysteresis (ISSUE 5, health-gated ring):
+        # _route_bad_since = start of the current circuit-open streak
+        # (0 while healthy); _route_recovered_at = when the last streak
+        # ended; _route_ejected = this peer is currently out of the
+        # routing ring and held out until the readmit window passes
+        self._route_bad_since = 0.0
+        self._route_recovered_at = 0.0
+        self._route_ejected = False
         fwd_timeout = behaviors.batch_timeout_ms / 1000.0 + 60.0
         upd_timeout = behaviors.global_timeout_ms / 1000.0
         if _wire_native is not None:
@@ -422,7 +439,18 @@ class PeerClient:
 
     # ---- circuit breaker -----------------------------------------------
 
+    def _fault(self, point: str) -> None:
+        """Fire a faultpoint tagged with this peer's address (no-op
+        while disarmed — one attribute read)."""
+        f = self._faults
+        if f is not None and f.armed:
+            f.fire(point, self.info.grpc_address)
+
     def _circuit_blocked(self) -> bool:
+        f = self._faults
+        if (f is not None and f.armed
+                and f.should("peer_circuit", self.info.grpc_address)):
+            return True
         with self._circ_mu:
             return time.monotonic() < self._open_until
 
@@ -435,9 +463,16 @@ class PeerClient:
             self._consec_failures += 1
             if self._consec_failures < threshold:
                 return
-            was_open = time.monotonic() < self._open_until
-            self._open_until = time.monotonic() + cooldown
+            now = time.monotonic()
+            was_open = now < self._open_until
+            self._open_until = now + cooldown
             self._circuit_opens += 1
+            # routing health: the open streak starts at the FIRST open
+            # and survives half-open probe failures (re-opens extend
+            # it) — only a success ends it
+            if self._route_bad_since == 0.0:
+                self._route_bad_since = now
+            self._route_recovered_at = 0.0
         if not was_open:
             log.warning("peer %s circuit OPEN after %d consecutive "
                         "flush failures; failing fast for %.1fs",
@@ -454,6 +489,9 @@ class PeerClient:
             was_open = self._open_until > 0
             self._consec_failures = 0
             self._open_until = 0.0
+            if self._route_bad_since:
+                self._route_bad_since = 0.0
+                self._route_recovered_at = time.monotonic()
         if was_open:
             log.info("peer %s circuit closed (probe flush succeeded)",
                      self.info.grpc_address)
@@ -465,12 +503,54 @@ class PeerClient:
         """Operator-facing circuit state (deep healthz)."""
         return self._circuit_blocked()
 
+    def route_healthy(self, eject_after_s: float,
+                      readmit_after_s: float) -> bool:
+        """Routing-ring health with hysteresis (ISSUE 5): False ejects
+        this peer from the health-gated ring.
+
+        Eject only after the circuit-open streak has lasted
+        ``eject_after_s`` (a transient blip never moves keys); once
+        ejected, readmit only after the peer has stayed recovered for
+        ``readmit_after_s`` — a peer flapping open/closed inside the
+        window stays out, so keys rehome exactly once per outage."""
+        now = time.monotonic()
+        with self._circ_mu:
+            if self._route_bad_since:
+                if now - self._route_bad_since >= eject_after_s:
+                    self._route_ejected = True
+                    return False
+                return True
+            if self._route_ejected:
+                if (self._route_recovered_at
+                        and now - self._route_recovered_at
+                        >= readmit_after_s):
+                    self._route_ejected = False
+                    return True
+                return False
+            return True
+
+    def probe(self):
+        """One empty flush through the globals lane — the health
+        prober's half-open probe for EJECTED peers (rehomed keys mean
+        no organic traffic would ever close their circuit).  A 0-item
+        UpdatePeerGlobals is a real RPC the peer answers trivially;
+        success runs ``_record_success`` and starts the readmit clock.
+        Returns the flush Future, or None when probing isn't possible
+        (no native lanes / closing)."""
+        if self._closing.is_set() or self._globals_lane is None:
+            return None
+        try:
+            return self._globals_lane.enqueue(b"", 0)
+        except (ErrClosing, ErrCircuitOpen):
+            return None
+
     def lane_stats(self) -> dict:
         """Send-lane + circuit state for /healthz?deep=1."""
         with self._circ_mu:
             circ = {"open": time.monotonic() < self._open_until,
                     "consecutive_failures": self._consec_failures,
-                    "opens": self._circuit_opens}
+                    "opens": self._circuit_opens,
+                    "route_ejected": self._route_ejected}
         out = {"circuit": circ}
         if self._forward_lane is not None:
             out["forward"] = self._forward_lane.stats()

@@ -88,8 +88,12 @@ def test_daemon_departure_keys_rehome(churn_cluster):
 
 
 def test_forward_error_surfaces_per_request(churn_cluster):
-    """A dead peer in the ring must surface as a per-request error, not
-    an exception (gubernator.go wraps peer failures in resp.Error)."""
+    """A dead peer in the ring must surface per-request — never as an
+    exception.  Since ISSUE 5 the default surface is a DEGRADED local
+    answer (flagged, hits queued for reconcile) instead of an error row
+    (gubernator.go wraps peer failures in resp.Error; that legacy
+    error-row shape is pinned with peer_degraded_fallback=False in
+    tests/test_resilience.py)."""
     c = churn_cluster
     inst = c.instance_at(0)
     from gubernator_tpu.types import PeerInfo
@@ -105,6 +109,9 @@ def test_forward_error_surfaces_per_request(churn_cluster):
                    == "127.0.0.1:1"][:3]
         assert victims, "no keys landed on the dead peer"
         rs = inst.get_rate_limits([req("churn", k) for k in victims])
-        assert all("peer" in r.error for r in rs)
+        assert all(r.error == ""
+                   and r.metadata.get("degraded") == "true"
+                   and r.metadata.get("degraded_peer") == "127.0.0.1:1"
+                   for r in rs)
     finally:
         inst.set_peers(live)

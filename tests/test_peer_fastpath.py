@@ -185,11 +185,16 @@ class TestPeerDeathCircuit:
 
     @pytest.fixture()
     def fast_circuit(self):
+        # degraded fallback + health gating OFF: this test pins the
+        # raw error-row / fail-fast semantics underneath them (ISSUE 5
+        # covers the degraded path in tests/test_resilience.py)
         return BehaviorConfig(batch_timeout_ms=200, batch_wait_ms=100,
                               peer_retry_limit=1,
                               peer_retry_backoff_ms=5,
                               peer_circuit_threshold=2,
-                              peer_circuit_cooldown_ms=700)
+                              peer_circuit_cooldown_ms=700,
+                              peer_degraded_fallback=False,
+                              peer_health_gate=False)
 
     def test_retry_circuit_failfast_recover(self, fast_circuit):
         c = cluster_mod.start(2, behaviors=fast_circuit)

@@ -1,0 +1,656 @@
+"""Failure-domain resilience (ISSUE 5): fault injection, degraded-mode
+owner fallback, health-gated ring, overload shedding, drain.
+
+Pinned here:
+- chaos soak: a faultpoint kills one owner mid-load on a 3-daemon
+  cluster under 16 concurrent callers — clients observe ZERO error rows
+  (degraded flags instead), hit counts reconcile exactly on recovery,
+  and the ejected peer's keys rehome and return with no flapping
+  (ring-generation delta is exactly eject + readmit);
+- fault harness: spec grammar, deterministic replay, loud unknown
+  points, HTTP (`/debug/faults`) and CLI (`guber-cli debug faults`)
+  arming, the injected-fault metric;
+- overload admission: queue-full / deadline / drain shedding with
+  `ResourceExhausted`, cheap and observable, accepted work completes;
+- drain-aware `/healthz`: 503 "draining" during the close grace window,
+  `drain_started`/`drain_completed` flight-recorder events;
+- forward-failure attribution: error rows name the failed peer and
+  `gubernator_forward_failed{peer_addr,reason}` counts them.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.dispatcher import (Dispatcher, ResourceExhausted,
+                                       request_deadline)
+from gubernator_tpu.faults import FAULT_POINTS, FaultInjected, FaultSet
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+pytest.importorskip("gubernator_tpu.ops._native",
+                    reason="resilience tests ride the columnar lanes")
+
+DAY = 24 * 3_600_000
+NOW0 = 1_770_000_000_000
+LIMIT = 10 ** 6
+
+
+def serialize(reqs):
+    msg = pb.GetRateLimitsReq()
+    for r in reqs:
+        m = msg.requests.add()
+        m.name = r.name
+        m.unique_key = r.unique_key
+        m.hits = r.hits
+        m.limit = r.limit
+        m.duration = r.duration
+        m.algorithm = int(r.algorithm)
+        m.behavior = int(r.behavior)
+        m.burst = r.burst
+    return msg.SerializeToString()
+
+
+def one(key: str, hits: int, name="soak") -> bytes:
+    return serialize([RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=LIMIT,
+        duration=DAY)])
+
+
+def wait_until(pred, timeout=30.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def gauge(g) -> float:
+    return g._value.get()
+
+
+# ---------------------------------------------------------------------------
+# fault harness unit tests (no cluster)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultSpec:
+    def test_error_mode_with_probability(self):
+        fs = FaultSet()
+        fs.arm("peer_send:error:0.25")
+        d = fs.describe()
+        assert d["armed"] and len(d["points"]) == 1
+        p = d["points"][0]
+        assert (p["point"], p["mode"], p["prob"]) == \
+            ("peer_send", "error", 0.25)
+
+    def test_delay_mode_needs_duration(self):
+        fs = FaultSet()
+        with pytest.raises(ValueError):
+            fs.arm("device_step:delay")
+        fs.arm("device_step:delay:5ms:0.5")
+        p = fs.describe()["points"][0]
+        assert p["delay_ms"] == 5.0 and p["prob"] == 0.5
+
+    def test_peer_tag_keeps_its_port(self):
+        fs = FaultSet()
+        fs.arm("peer_send@10.0.0.2:5001:error")
+        p = fs.describe()["points"][0]
+        assert p["tag"] == "10.0.0.2:5001" and p["mode"] == "error"
+        # tagged point only fires for its tag
+        with pytest.raises(FaultInjected):
+            fs.fire("peer_send", "10.0.0.2:5001")
+        fs.fire("peer_send", "10.0.0.9:5001")  # no raise
+
+    def test_unknown_point_is_loud(self):
+        fs = FaultSet()
+        with pytest.raises(ValueError, match="unknown faultpoint"):
+            fs.arm("peer_snd:error")
+        assert not fs.armed  # nothing armed on a typo'd chaos run
+
+    def test_bad_probability_rejected(self):
+        fs = FaultSet()
+        with pytest.raises(ValueError):
+            fs.arm("peer_send:error:1.5")
+
+    def test_deterministic_replay(self):
+        def seq(seed):
+            fs = FaultSet(seed=seed)
+            fs.arm("peer_send:error:0.5")
+            out = []
+            for _ in range(64):
+                try:
+                    fs.fire("peer_send", "a")
+                    out.append(0)
+                except FaultInjected:
+                    out.append(1)
+            return out
+
+        a, b = seq(7), seq(7)
+        assert a == b and 0 < sum(a) < 64
+        assert seq(8) != a
+
+    def test_disarm_and_from_env(self):
+        fs = FaultSet.from_env(
+            {"GUBER_FAULT": "snapshot:error", "GUBER_FAULT_SEED": "3"})
+        assert fs.armed and fs.seed == 3
+        fs.arm("")
+        assert not fs.armed
+        fs.fire("snapshot")  # disarmed → no raise
+
+    def test_should_gates_conditions(self):
+        fs = FaultSet()
+        fs.arm("peer_circuit:error")
+        assert fs.should("peer_circuit", "x") is True
+        fs.clear()
+        assert fs.should("peer_circuit", "x") is False
+
+    def test_catalog_documented(self):
+        # RESILIENCE.md carries the operator-facing catalog; keep the
+        # code-side one non-empty and stable in shape
+        assert "peer_send" in FAULT_POINTS
+        assert all(isinstance(v, str) and v for v in FAULT_POINTS.values())
+
+
+# ---------------------------------------------------------------------------
+# HTTP + CLI arming, injected-fault accounting
+# ---------------------------------------------------------------------------
+
+
+class TestFaultEndpoints:
+    @pytest.fixture(scope="class")
+    def solo(self):
+        c = cluster_mod.start(1)
+        yield c
+        c.stop()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10) as f:
+            return json.loads(f.read())
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as f:
+            return json.loads(f.read())
+
+    def test_http_arm_inspect_clear(self, solo):
+        url = solo.http_address(0) + "/debug/faults"
+        out = self._post(url, {"spec": "device_step:delay:1ms",
+                               "seed": 11})
+        assert out["armed"] and out["seed"] == 11
+        got = self._get(url)
+        assert got["points"][0]["point"] == "device_step"
+        assert sorted(got["catalog"]) == sorted(FAULT_POINTS)
+        out = self._post(url, {"clear": True})
+        assert not out["armed"]
+
+    def test_http_bad_spec_is_400(self, solo):
+        url = solo.http_address(0) + "/debug/faults"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(url, {"spec": "nope:error"})
+        assert ei.value.code == 400
+        assert not solo.instance_at(0).faults.armed
+
+    def test_cli_round_trip(self, solo, capsys):
+        from gubernator_tpu.cmd.cli import main
+
+        base = solo.http_address(0)
+        assert main(["debug", "faults", "--url", base, "--set",
+                     "wire_ingest:error:0.5", "--seed", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "ARMED" in out and "wire_ingest" in out
+        assert main(["debug", "faults", "--url", base, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["armed"] and doc["seed"] == 5
+        assert main(["debug", "faults", "--url", base, "--clear"]) == 0
+        assert "disarmed" in capsys.readouterr().out
+
+    def test_injected_fault_raises_and_counts(self, solo):
+        inst = solo.instance_at(0)
+        inst.faults.arm("wire_ingest:error")
+        try:
+            with pytest.raises(FaultInjected):
+                inst.get_rate_limits_wire(one("fi", 1), now_ms=NOW0)
+            assert inst.metrics.fault_injected.labels(
+                point="wire_ingest")._value.get() >= 1
+            fired = inst.faults.describe()["points"][0]["fired"]
+            assert fired >= 1
+        finally:
+            inst.faults.clear()
+        # disarmed again: the same call serves
+        out = pb.GetRateLimitsResp.FromString(
+            inst.get_rate_limits_wire(one("fi", 1), now_ms=NOW0))
+        assert out.responses[0].error == ""
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: owner kill → degrade → eject/rehome → recover → reconcile
+# ---------------------------------------------------------------------------
+
+
+SOAK_B = BehaviorConfig(
+    batch_timeout_ms=400, batch_wait_ms=100,
+    peer_retry_limit=1, peer_retry_backoff_ms=5,
+    peer_circuit_threshold=2, peer_circuit_cooldown_ms=250,
+    peer_eject_after_ms=300, peer_readmit_after_ms=250,
+    global_sync_wait_ms=100)
+
+
+class TestChaosSoak:
+    N_THREADS = 16
+
+    def _hammer(self, c, keys, hits, reps, ledger=None, expect_flag=None):
+        """16 callers over daemons 0/1; every response must be an
+        error-free row (zero lost responses, zero error rows).
+        ``ledger`` accumulates hits per key; ``expect_flag`` maps
+        key → required value of the degraded metadata flag."""
+        errs = []
+        mu = threading.Lock()
+
+        def worker(t):
+            inst = c.instance_at(t % 2)
+            try:
+                for r in range(reps):
+                    key = keys[(t + r) % len(keys)]
+                    out = pb.GetRateLimitsResp.FromString(
+                        inst.get_rate_limits_wire(
+                            one(key, hits),
+                            now_ms=NOW0 + 1 + r))
+                    assert len(out.responses) == 1, "lost response"
+                    resp = out.responses[0]
+                    assert resp.error == "", f"{key}: {resp.error}"
+                    if expect_flag is not None:
+                        want = expect_flag[key]
+                        got = resp.metadata.get("degraded", "") == "true"
+                        assert got == want, \
+                            f"{key}: degraded={got}, want {want}"
+                    if ledger is not None:
+                        with mu:
+                            ledger[key] = ledger.get(key, 0) + hits
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        ths = [threading.Thread(target=worker, args=(t,))
+               for t in range(self.N_THREADS)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=120)
+        assert not any(th.is_alive() for th in ths), "stuck caller"
+        assert not errs, errs[:3]
+
+    def test_owner_kill_degrade_reconcile_recover(self):
+        c = cluster_mod.start(3, behaviors=SOAK_B)
+        try:
+            self._run_soak(c)
+        finally:
+            c.stop()
+
+    def _run_soak(self, c):
+        i0, i1 = c.instance_at(0), c.instance_at(1)
+        victim = c.daemon_at(2)
+        vaddr = c.peer_at(2).grpc_address
+
+        # split a key universe by membership owner
+        vkeys, okeys, wkeys = [], [], []
+        for i in range(400):
+            k = f"k{i}"
+            owned = c.owner_daemon_of("soak_" + k) is victim
+            if owned and len(vkeys) < 6:
+                vkeys.append(k)
+            elif owned and len(wkeys) < 4:
+                wkeys.append(k)  # uncounted warm-kill keys
+            elif not owned and len(okeys) < 4:
+                okeys.append(k)
+            if len(vkeys) == 6 and len(okeys) == 4 and len(wkeys) == 4:
+                break
+        assert len(vkeys) == 6 and len(okeys) == 4 and len(wkeys) == 4
+
+        ledger: dict = {}
+        keys = vkeys + okeys
+        # warm every counted key's row at its owner (hits=0 through
+        # both caller daemons), as the PR-3 conservation test does:
+        # concurrent COLD-create across lanes can lose a call's hits
+        # (pre-existing dispatcher bug, ROADMAP open item — repro in
+        # its entry), and this soak pins the resilience layer, not
+        # that bug
+        for inst in (i0, i1):
+            for k in keys + wkeys:
+                inst.get_rate_limits_wire(one(k, 0), now_ms=NOW0)
+        gen0 = [gauge(i.metrics.ring_generation) for i in (i0, i1)]
+
+        # phase A — healthy: nothing degraded, normal forwards
+        self._hammer(c, keys, hits=2, reps=6, ledger=ledger,
+                     expect_flag={k: False for k in keys})
+
+        # kill: every send to the victim fails, deterministically
+        for inst in (i0, i1):
+            inst.faults.arm(f"peer_send@{vaddr}:error", seed=7)
+
+        # phase B1 — drive failures (uncounted keys) until BOTH
+        # daemons' health gates eject the victim; responses stay
+        # error-free the whole way (degraded fallback from the first
+        # failed forward, before any ejection)
+        def both_ejected():
+            self._hammer(c, wkeys, hits=1, reps=2)
+            return all(gauge(i.metrics.ring_ejected_peers) == 1
+                       for i in (i0, i1))
+
+        wait_until(both_ejected, timeout=60, what="both daemons ejecting "
+                   "the victim from their routing rings")
+
+        # phase B2 — steady degraded state, counted: victim-owned keys
+        # answer with the degraded flag (rehomed locally or flagged by
+        # the rehome target), healthy keys stay clean
+        flags = {k: True for k in vkeys}
+        flags.update({k: False for k in okeys})
+        self._hammer(c, keys, hits=3, reps=6, ledger=ledger,
+                     expect_flag=flags)
+        assert gauge(i0.metrics.peer_circuit_open_counter.labels(
+            peer_addr=vaddr)) >= 1
+        deg_total = sum(
+            gauge(i.metrics.degraded_served.labels(peer_addr=vaddr))
+            for i in (i0, i1))
+        assert deg_total > 0
+
+        # phase C — recover: clear the faults; the ring probe closes
+        # the victim's circuit, hysteresis readmits it
+        for inst in (i0, i1):
+            inst.faults.clear()
+
+        def both_readmitted():
+            # light uncounted traffic keeps the routing gate re-deriving
+            self._hammer(c, okeys[:1], hits=0, reps=1)
+            return all(gauge(i.metrics.ring_ejected_peers) == 0
+                       for i in (i0, i1))
+
+        wait_until(both_readmitted, timeout=60,
+                   what="victim readmitted on both daemons")
+
+        # reconcile: queued degraded hits flush to the recovered owner.
+        # "queues empty" is not enough — a tick POPS the queues before
+        # its flush lands (and requeues on failure), so wait for the
+        # conservation numbers themselves to converge.
+        def conserved():
+            for inst in (i0, i1):
+                gm = inst.global_manager
+                if gm is not None:
+                    gm._hits_loop.poke()
+            for key in keys:
+                out = pb.GetRateLimitsResp.FromString(
+                    i0.get_rate_limits_wire(one(key, 0),
+                                            now_ms=NOW0 + 9_000))
+                if LIMIT - int(out.responses[0].remaining) \
+                        != ledger[key]:
+                    return False
+            return True
+
+        wait_until(conserved, timeout=60, interval=0.2,
+                   what="degraded hits reconciling exactly to the "
+                        "recovered owner")
+
+        # no flapping: one outage costs exactly two ring bumps
+        for i, inst in enumerate((i0, i1)):
+            delta = gauge(inst.metrics.ring_generation) - gen0[i]
+            assert delta == 2, f"daemon {i}: ring flapped ({delta} bumps)"
+
+        # exact conservation: every counted hit debited exactly once,
+        # observable identically through both healthy daemons
+        for key in keys:
+            seen = set()
+            for inst in (i0, i1):
+                out = pb.GetRateLimitsResp.FromString(
+                    inst.get_rate_limits_wire(one(key, 0),
+                                              now_ms=NOW0 + 10_000))
+                resp = out.responses[0]
+                assert resp.error == ""
+                assert "degraded" not in resp.metadata
+                seen.add(int(resp.remaining))
+            assert len(seen) == 1, f"{key}: split view {seen}"
+            debited = LIMIT - seen.pop()
+            assert debited == ledger[key], \
+                f"{key}: {debited} debited != {ledger[key]} sent"
+
+
+# ---------------------------------------------------------------------------
+# overload admission control
+# ---------------------------------------------------------------------------
+
+
+class _GatedEngine:
+    """check_batch blocks until released — deterministic backlog."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+
+    def check_batch(self, reqs, now_ms):
+        assert self.gate.wait(30), "test gate never released"
+        return [RateLimitResponse(limit=r.limit, remaining=r.limit - r.hits)
+                for r in reqs]
+
+
+def _req(key, hits=1):
+    return RateLimitRequest(name="ovl", unique_key=key, hits=hits,
+                            limit=1000, duration=DAY)
+
+
+class TestOverloadShedding:
+    def test_queue_full_sheds_resource_exhausted(self):
+        from gubernator_tpu.metrics import Metrics
+
+        m = Metrics()
+        eng = _GatedEngine()
+        d = Dispatcher(eng, max_wave=4, max_delay_ms=0, metrics=m)
+        d.admission_limit = 8
+        done, errs = [], []
+
+        def caller(i):
+            try:
+                done.append(d.check_batch([_req(f"q{i}_{j}")
+                                           for j in range(4)], NOW0))
+            except ResourceExhausted:
+                errs.append(i)
+
+        try:
+            ths = []
+            # one wave (4 rows) blocks in the engine; the queue then
+            # holds at most admission_limit rows; the rest shed
+            for i in range(6):
+                th = threading.Thread(target=caller, args=(i,))
+                th.start()
+                ths.append(th)
+                time.sleep(0.05)
+            wait_until(lambda: len(errs) >= 1, timeout=10,
+                       what="a shed caller")
+            eng.gate.set()
+            for th in ths:
+                th.join(timeout=30)
+            assert len(done) + len(errs) == 6
+            assert done, "every caller shed — gate broken"
+            # accepted callers all completed with full responses
+            assert all(len(r) == 4 for r in done)
+            assert m.admission_shed.labels(
+                reason="queue_full")._value.get() >= 4
+        finally:
+            eng.gate.set()
+            d.close()
+
+    def test_deadline_shed_only_with_backlog(self):
+        from gubernator_tpu.metrics import Metrics
+
+        m = Metrics()
+        eng = _GatedEngine()
+        eng.gate.set()
+        d = Dispatcher(eng, max_wave=4, metrics=m)
+        try:
+            # empty queue: any deadline admits (work launches at once)
+            d.admit(4, deadline_s=0.001)
+            # backlog + observed slow waves: projected wait exceeds the
+            # caller deadline → shed
+            with d._tel_mu:
+                d._recent_sizes.append(4)
+                d._recent_durs.append(5.0)
+            with d._submit_mu:
+                d._queued_rows = 8
+            with pytest.raises(ResourceExhausted):
+                d.admit(4, deadline_s=1.0)
+            assert m.admission_shed.labels(
+                reason="deadline")._value.get() == 4
+            # a generous deadline still admits through the same backlog
+            d.admit(4, deadline_s=60.0)
+            # the ContextVar front door carries the deadline too
+            with request_deadline(1.0):
+                with pytest.raises(ResourceExhausted):
+                    d.admit(4)
+            with d._submit_mu:
+                d._queued_rows = 0
+        finally:
+            d.close()
+
+    def test_drain_sheds_new_ingress(self):
+        from gubernator_tpu.metrics import Metrics
+
+        m = Metrics()
+        eng = _GatedEngine()
+        eng.gate.set()
+        d = Dispatcher(eng, metrics=m)
+        try:
+            assert len(d.check_batch([_req("d0")], NOW0)) == 1
+            d.drain()
+            # new ingress (the admit gate every client path runs) sheds
+            with pytest.raises(ResourceExhausted):
+                d.admit(1)
+            assert m.admission_shed.labels(
+                reason="draining")._value.get() == 1
+            # but in-flight / peer-side work still completes: drain
+            # finishes what's already inside the daemon
+            assert len(d.check_batch([_req("d1")], NOW0)) == 1
+        finally:
+            d.close()
+
+    def test_admission_stats_in_debug(self):
+        eng = _GatedEngine()
+        eng.gate.set()
+        d = Dispatcher(eng)
+        try:
+            d.check_batch([_req("s0")], NOW0)
+            st = d.debug_stats()["admission"]
+            assert st["limit_rows"] == d.admission_limit
+            assert st["queued_rows"] == 0 and not st["draining"]
+        finally:
+            d.close()
+
+
+# ---------------------------------------------------------------------------
+# drain-aware /healthz
+# ---------------------------------------------------------------------------
+
+
+class TestDrain:
+    def test_healthz_reports_draining_during_grace(self):
+        c = cluster_mod.start(1, drain_grace_ms=800)
+        d = c.daemon_at(0)
+        url = c.http_address(0) + "/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as f:
+                assert json.loads(f.read())["status"] == "healthy"
+            closer = threading.Thread(target=d.close)
+            closer.start()
+
+            def draining():
+                try:
+                    with urllib.request.urlopen(url, timeout=2) as f:
+                        json.loads(f.read())
+                    return False
+                except urllib.error.HTTPError as e:
+                    body = json.loads(e.read())
+                    return (e.code == 503
+                            and body["status"] == "draining")
+                except OSError:
+                    return False
+
+            wait_until(draining, timeout=5,
+                       what="healthz flipping to 503 draining")
+            assert gauge(d.instance.metrics.draining) == 1
+            closer.join(timeout=30)
+            assert not closer.is_alive()
+            kinds = [e["kind"] for e in d.instance.recorder.events()]
+            assert "drain_started" in kinds
+            assert "drain_completed" in kinds
+            assert kinds.index("drain_started") < \
+                kinds.index("drain_completed")
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos-matrix harness smoke (tools/chaos_matrix.py, `make chaos`)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosMatrixSmoke:
+    def test_matrix_subset_runs_clean(self):
+        from tools.chaos_matrix import MATRIX, run_matrix
+
+        from gubernator_tpu.faults import FAULT_POINTS
+
+        # the full matrix is `make chaos`; tier-1 smokes a cross-layer
+        # subset and the driver-coverage lint
+        assert set(MATRIX) == set(FAULT_POINTS)
+        verdict = run_matrix(
+            points=["wire_ingest", "peer_send", "device_step",
+                    "snapshot"])
+        assert verdict["ok"], verdict["failed"]
+        assert verdict["exercised"] >= 7
+
+
+# ---------------------------------------------------------------------------
+# forward-failure attribution (ISSUE 5 small fix)
+# ---------------------------------------------------------------------------
+
+
+class TestForwardFailedAttribution:
+    def test_error_rows_name_the_peer_and_count(self):
+        b = BehaviorConfig(batch_timeout_ms=200, batch_wait_ms=100,
+                           peer_retry_limit=1, peer_retry_backoff_ms=5,
+                           peer_circuit_threshold=2,
+                           peer_circuit_cooldown_ms=700,
+                           peer_degraded_fallback=False,
+                           peer_health_gate=False)
+        c = cluster_mod.start(2, behaviors=b)
+        try:
+            inst = c.instance_at(0)
+            addr1 = c.peer_at(1).grpc_address
+            keys = []
+            for i in range(200):
+                k = f"ff{i}"
+                if c.owner_daemon_of("soak_" + k) is c.daemon_at(1):
+                    keys.append(k)
+                if len(keys) == 3:
+                    break
+            assert keys
+            c.daemon_at(1).close()
+            out = pb.GetRateLimitsResp.FromString(
+                inst.get_rate_limits_wire(
+                    serialize([RateLimitRequest(
+                        name="soak", unique_key=k, hits=1, limit=10,
+                        duration=DAY) for k in keys]),
+                    now_ms=NOW0))
+            for r in out.responses:
+                assert "while fetching rate limit from peer" in r.error
+                assert addr1 in r.error  # WHICH owner failed
+            fam = inst.metrics.forward_failed.collect()[0]
+            failed = sum(s.value for s in fam.samples
+                         if s.name.endswith("_total")
+                         and s.labels.get("peer_addr") == addr1)
+            assert failed >= len(keys)
+        finally:
+            c.stop()

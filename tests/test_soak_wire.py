@@ -39,7 +39,16 @@ def mk(i):
 
 
 def test_wire_soak_with_daemon_restart():
-    cluster = cluster_mod.start(3)
+    # resilience fallbacks OFF (ISSUE 5): this soak pins the wire-lane
+    # + buffer-pool invariants under churn with the LEGACY forward
+    # semantics (error rows, single-bucket strict admission).  With
+    # degraded fallback on, a slow restart window serves the strict
+    # key from multiple local shards by design — that bounded-staleness
+    # trade is pinned by tests/test_resilience.py instead.
+    from gubernator_tpu.config import BehaviorConfig
+
+    cluster = cluster_mod.start(3, behaviors=BehaviorConfig(
+        peer_degraded_fallback=False, peer_health_gate=False))
     lock = threading.Lock()
     hard_errors = []
     transient = []

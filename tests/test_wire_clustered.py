@@ -119,8 +119,11 @@ class TestClusteredWireLane:
         assert remaining[:5] == [4, 3, 2, 1, 0]
 
     def test_dead_peer_degrades_per_subbatch(self, cluster):
-        """Requests owned by a dead peer get error responses; everything
-        else still succeeds (object-path forward-error semantics)."""
+        """Requests owned by a dead peer serve DEGRADED from the local
+        shard (ISSUE 5): flagged success rows, never error rows;
+        everything else is untouched.  The raw error-row semantics
+        underneath the fallback stay pinned by test_peer_fastpath's
+        death test (peer_degraded_fallback=False)."""
         inst = cluster.instance_at(0)
         # find keys owned by daemon 2 vs daemon 0
         owned2, owned_other = [], []
@@ -141,11 +144,18 @@ class TestClusteredWireLane:
                                           now_ms=clock_ms()))
             by_key = dict(zip(owned2[:5] + owned_other[:5], out.responses))
             for k in owned2[:5]:
-                assert "while fetching rate limit from peer" in \
-                    by_key[k].error
+                r = by_key[k]
+                assert r.error == ""
+                assert r.metadata["degraded"] == "true"
+                # answered from daemon 0's own (empty) shard
+                assert int(r.remaining) == 9
             for k in owned_other[:5]:
                 assert by_key[k].error == ""
+                assert "degraded" not in by_key[k].metadata
                 assert int(by_key[k].remaining) == 9
+            assert inst.metrics.degraded_served.labels(
+                peer_addr=cluster.peer_at(2).grpc_address
+            )._value.get() >= 5
         finally:
             # restore daemon 2 for any later test using the fixture
             cluster.restart(2)

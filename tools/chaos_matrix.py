@@ -1,0 +1,320 @@
+"""Chaos matrix (ISSUE 5): every faultpoint × {error, delay} against a
+live in-proc cluster, with a JSON verdict table.
+
+For each cell the matrix arms ONE faultpoint on daemon 0 of a 2-daemon
+loopback cluster (snapshot/restore run against a solo MockLoader
+instance), drives the code path that owns the point, and classifies the
+outcome:
+
+- ``served``            the operation completed with clean rows
+- ``served_degraded``   completed, rows carry the degraded flag
+- ``error_rows``        completed, rows carry error text (visible, loud)
+- ``raised``            the operation raised ``FaultInjected`` (loud)
+- ``aborted_tick``      an async tick saw the fault and aborted safely
+- ``not_reached``       the armed point never fired on this host
+                        (e.g. ``dispatch_sync`` without a pipelined
+                        engine) — recorded, not counted as failure
+- ``hung``              the operation exceeded its wall bound — FAILURE
+
+A cell passes (``ok``) when it did not hang and a clean probe call
+succeeds after the fault is cleared (recovery).  The point of the
+matrix is the invariant the resilience layer promises: an injected
+fault may degrade or fail loudly, but may never wedge the daemon or
+leave it broken after the fault clears.
+
+Usage::
+
+    python tools/chaos_matrix.py [--json out.json] [--verbose]
+    make chaos
+
+Exit 0 when every exercised cell is ok; 1 otherwise.  Tier-1-safe:
+in-proc daemons, loopback only, a few seconds of wall time
+(tests/test_resilience.py runs a smoke of the same harness).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DAY = 24 * 3_600_000
+NOW0 = 1_780_000_000_000
+WALL_S = 30.0  # per-cell bound: anything slower than this is a hang
+
+
+def _serialize(reqs):
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    msg = pb.GetRateLimitsReq()
+    for r in reqs:
+        m = msg.requests.add()
+        for f in ("name", "unique_key", "hits", "limit", "duration",
+                  "burst"):
+            setattr(m, f, getattr(r, f))
+        m.algorithm = int(r.algorithm)
+        m.behavior = int(r.behavior)
+    return msg.SerializeToString()
+
+
+def _one(key, hits=1, behavior=0):
+    from gubernator_tpu.types import RateLimitRequest
+
+    return _serialize([RateLimitRequest(
+        name="chaos", unique_key=key, hits=hits, limit=10 ** 6,
+        duration=DAY, behavior=behavior)])
+
+
+class _Ctx:
+    """The live fixture the drivers run against."""
+
+    def __init__(self):
+        from gubernator_tpu import cluster as cluster_mod
+        from gubernator_tpu.config import BehaviorConfig
+
+        self.c = cluster_mod.start(2, behaviors=BehaviorConfig(
+            batch_timeout_ms=300, batch_wait_ms=50,
+            peer_retry_limit=1, peer_retry_backoff_ms=5,
+            peer_circuit_threshold=2, peer_circuit_cooldown_ms=200,
+            global_sync_wait_ms=50))
+        self.i0 = self.c.instance_at(0)
+        self.addr1 = self.c.peer_at(1).grpc_address
+        # a key owned by daemon 1 (remote from daemon 0's view) and one
+        # owned by daemon 0
+        self.remote_key = self.local_key = None
+        for i in range(200):
+            k = f"ck{i}"
+            owner = self.c.owner_daemon_of("chaos_" + k)
+            if owner is self.c.daemon_at(1) and self.remote_key is None:
+                self.remote_key = k
+            if owner is self.c.daemon_at(0) and self.local_key is None:
+                self.local_key = k
+            if self.remote_key and self.local_key:
+                break
+        assert self.remote_key and self.local_key
+        # solo instance with a MockLoader for snapshot/restore points
+        from gubernator_tpu.config import Config
+        from gubernator_tpu.instance import V1Instance
+        from gubernator_tpu.store import MockLoader
+
+        cfg = Config(behaviors=BehaviorConfig())
+        cfg.loader = MockLoader()
+        self.solo = V1Instance(cfg)
+
+    def close(self):
+        try:
+            self.solo.close()
+        finally:
+            self.c.stop()
+
+
+def _classify_rows(data: bytes) -> str:
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    out = pb.GetRateLimitsResp.FromString(data)
+    if any(r.error for r in out.responses):
+        return "error_rows"
+    if any(r.metadata.get("degraded") == "true" for r in out.responses):
+        return "served_degraded"
+    return "served"
+
+
+# ---- drivers: one per faultpoint -------------------------------------------
+# each returns an outcome string; FaultInjected escaping is normalized
+# to "raised" by the harness
+
+
+def _drive_forward(ctx: _Ctx) -> str:
+    """peer_send / peer_recv / peer_circuit: a client batch whose key
+    the ring owns remotely — the forward path."""
+    return _classify_rows(ctx.i0.get_rate_limits_wire(
+        _one(ctx.remote_key), now_ms=NOW0))
+
+
+def _drive_ingest(ctx: _Ctx) -> str:
+    return _classify_rows(ctx.i0.get_rate_limits_wire(
+        _one(ctx.local_key), now_ms=NOW0))
+
+
+def _drive_dispatch(ctx: _Ctx) -> str:
+    """dispatch_enqueue / dispatch_launch / dispatch_sync /
+    device_step: a local batch forced through the QUEUED wave path (the
+    inline fast path bypasses the dispatcher queue, so occupy it)."""
+    disp = ctx.i0.dispatcher
+    box = {}
+
+    def call():
+        try:
+            box["out"] = _classify_rows(ctx.i0.get_rate_limits_wire(
+                _one(ctx.local_key), now_ms=NOW0))
+        except BaseException as e:  # noqa: BLE001 - classified by harness
+            box["err"] = e
+
+    with disp._inline_mu:  # the call below must take the queued path
+        th = threading.Thread(target=call)
+        th.start()
+        th.join(0.05)  # let it enqueue while inline is blocked
+    th.join(WALL_S)
+    if th.is_alive():
+        return "hung"
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
+
+
+def _drive_global(loop_attr: str):
+    def drive(ctx: _Ctx) -> str:
+        from gubernator_tpu.types import Behavior
+
+        # queue GLOBAL work on daemon 0 (owner side for local_key,
+        # non-owner for remote_key), then force the tick
+        ctx.i0.get_rate_limits_wire(
+            _one(ctx.local_key, behavior=int(Behavior.GLOBAL)),
+            now_ms=NOW0)
+        ctx.i0.get_rate_limits_wire(
+            _one(ctx.remote_key, behavior=int(Behavior.GLOBAL)),
+            now_ms=NOW0)
+        gm = ctx.i0.global_manager
+        before = ctx.i0.faults.describe()
+        fired0 = sum(p["fired"] for p in before["points"])
+        getattr(gm, loop_attr).poke()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            now = sum(p["fired"]
+                      for p in ctx.i0.faults.describe()["points"])
+            if now > fired0:
+                return "aborted_tick"
+            time.sleep(0.02)
+        return "served"  # tick ran without reaching the point
+
+    return drive
+
+
+def _drive_snapshot(ctx: _Ctx) -> str:
+    ctx.solo.get_rate_limits_wire(_one("snapkey"), now_ms=NOW0)
+    ctx.solo._save_to_loader()
+    return "served"
+
+
+def _drive_restore(ctx: _Ctx) -> str:
+    ctx.solo._load_from_loader()
+    return "served"
+
+
+def _probe(ctx: _Ctx) -> bool:
+    """Clean-path probe after clearing a fault: both a local and a
+    forwarded row must serve without error rows."""
+    try:
+        a = _classify_rows(ctx.i0.get_rate_limits_wire(
+            _one(ctx.local_key, hits=0), now_ms=NOW0 + 5_000))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            b = _classify_rows(ctx.i0.get_rate_limits_wire(
+                _one(ctx.remote_key, hits=0), now_ms=NOW0 + 5_000))
+            if a == "served" and b == "served":
+                return True
+            time.sleep(0.1)  # circuit cooldown / readmit settling
+        return False
+    except Exception:  # noqa: BLE001 - a raising probe is a failure
+        return False
+
+
+#: point → (driver, where to arm: "cluster" daemon-0 instance or "solo")
+MATRIX = {
+    "peer_send": (_drive_forward, "cluster"),
+    "peer_recv": (_drive_forward, "cluster"),
+    "peer_circuit": (_drive_forward, "cluster"),
+    "dispatch_enqueue": (_drive_dispatch, "cluster"),
+    "dispatch_launch": (_drive_dispatch, "cluster"),
+    "dispatch_sync": (_drive_dispatch, "cluster"),
+    "device_step": (_drive_dispatch, "cluster"),
+    "wire_ingest": (_drive_ingest, "cluster"),
+    "global_broadcast": (_drive_global("_bcast_loop"), "cluster"),
+    "global_hits": (_drive_global("_hits_loop"), "cluster"),
+    "snapshot": (_drive_snapshot, "solo"),
+    "restore": (_drive_restore, "solo"),
+}
+
+MODES = ("error", "delay")
+
+
+def run_matrix(points=None, verbose=False) -> dict:
+    from gubernator_tpu.faults import FAULT_POINTS, FaultInjected
+
+    missing = set(FAULT_POINTS) - set(MATRIX)
+    assert not missing, f"faultpoints without a matrix driver: {missing}"
+    ctx = _Ctx()
+    cells = []
+    try:
+        for point, (driver, where) in MATRIX.items():
+            if points and point not in points:
+                continue
+            inst = ctx.solo if where == "solo" else ctx.i0
+            for mode in MODES:
+                spec = (f"{point}:delay:5ms" if mode == "delay"
+                        else f"{point}:error")
+                inst.faults.arm(spec, seed=7)
+                t0 = time.perf_counter()
+                try:
+                    outcome = driver(ctx)
+                except FaultInjected:
+                    outcome = "raised"
+                except Exception as e:  # noqa: BLE001 - recorded verdict
+                    outcome = f"unexpected:{type(e).__name__}"
+                elapsed = time.perf_counter() - t0
+                fired = sum(p["fired"]
+                            for p in inst.faults.describe()["points"])
+                inst.faults.clear()
+                if fired == 0:
+                    outcome = "not_reached"
+                recovered = _probe(ctx) if where == "cluster" else True
+                ok = (outcome != "hung"
+                      and not outcome.startswith("unexpected")
+                      and recovered)
+                cell = {"point": point, "mode": mode, "spec": spec,
+                        "outcome": outcome, "fired": fired,
+                        "elapsed_ms": round(elapsed * 1000, 1),
+                        "recovered": recovered, "ok": ok}
+                cells.append(cell)
+                if verbose:
+                    print(json.dumps(cell), file=sys.stderr)
+    finally:
+        ctx.close()
+    exercised = [c for c in cells if c["outcome"] != "not_reached"]
+    return {
+        "cells": cells,
+        "exercised": len(exercised),
+        "not_reached": [f"{c['point']}:{c['mode']}" for c in cells
+                        if c["outcome"] == "not_reached"],
+        "failed": [f"{c['point']}:{c['mode']}" for c in cells
+                   if not c["ok"]],
+        "ok": all(c["ok"] for c in cells),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run the faultpoint × mode chaos matrix")
+    ap.add_argument("--json", default=None,
+                    help="also write the verdict table to this path")
+    ap.add_argument("--point", action="append", default=None,
+                    help="restrict to these faultpoints (repeatable)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="stream per-cell verdicts to stderr")
+    args = ap.parse_args(argv)
+    verdict = run_matrix(points=args.point, verbose=args.verbose)
+    doc = json.dumps(verdict, indent=2)
+    print(doc)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(doc + "\n")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,10 +2,46 @@
 
 PY ?= python
 
-.PHONY: test proto bench chaos tpu-session b-sweep daemon cluster lint native clean
+.PHONY: test proto bench chaos tpu-session b-sweep daemon cluster lint \
+        native tsan asan racer check clean
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# concurrency-discipline static analysis (tools/guberlint/, see
+# CONCURRENCY.md): guarded-by, lock order, GUBER_* env registry,
+# faultpoint catalog, thread inventory.  Zero violations at HEAD is a
+# tier-1 invariant (tests/test_lint_clean.py).
+lint:
+	$(PY) -m tools.guberlint
+
+# ThreadSanitizer build of ops/_native.cpp + the multithreaded native
+# soak under it (tools/native_soak.py; suppressions: tools/tsan.supp).
+# The production in-place .so is untouched — the instrumented build
+# lands in build/tsan/.
+tsan:
+	GUBER_NATIVE_SAN=tsan $(PY) gubernator_tpu/ops/setup_native.py \
+	    build_ext --build-lib build/tsan
+	$(PY) tools/native_soak.py --san tsan
+
+# AddressSanitizer twin of `make tsan` (build/asan/).
+asan:
+	GUBER_NATIVE_SAN=asan $(PY) gubernator_tpu/ops/setup_native.py \
+	    build_ext --build-lib build/asan
+	$(PY) tools/native_soak.py --san asan
+
+# seeded interleaving harness: adversarial preemptions at the
+# dispatcher merge/carry/splice faultpoints, conservation as oracle
+racer:
+	JAX_PLATFORMS=cpu $(PY) tools/racer.py --seed 1 --runs 2
+
+# CI-style gate: static analysis + sanitizer soaks + the concurrency
+# test subset (the full tier-1 battery stays `make test`)
+check: lint tsan asan
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_guberlint.py \
+	    tests/test_lint_clean.py tests/test_created_at.py \
+	    tests/test_cold_conservation.py tests/test_native.py \
+	    tests/test_interval.py tests/test_dispatcher.py -q
 
 # faultpoint × {error,delay} matrix against an in-proc cluster; exits
 # nonzero if any injected fault hangs the daemon or breaks recovery

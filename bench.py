@@ -475,8 +475,26 @@ def main():
                 "call pays one link round trip); direct-attach "
                 "estimate only")
     result["extra"]["baseline_configs"] = configs
+    # provenance: was the tree guberlint-clean when this row was
+    # measured?  A BENCH row from an unanalyzable tree (violated lock
+    # discipline, drifted registries) is a number with an asterisk —
+    # record the asterisk (CONCURRENCY.md; tools/guberlint).
+    result["extra"]["lint_clean"] = _lint_clean()
     _write_partial(result)
     print(json.dumps(result))
+
+
+def _lint_clean():
+    """True when `python -m tools.guberlint` would report zero
+    violations right now; False on violations; None when the linter
+    itself could not run (never fails the bench)."""
+    try:
+        from tools.guberlint import run_passes
+
+        return not run_passes()
+    except Exception as e:  # noqa: BLE001 - provenance only
+        log(f"lint_clean probe failed: {(str(e) or repr(e))[:120]}")
+        return None
 
 
 PARTIAL_PATH = os.environ.get("GUBER_BENCH_PARTIAL",

@@ -425,14 +425,14 @@ class KeyAnalytics:
         width = (width if width is not None
                  else _env_int("GUBER_SKETCH_WIDTH", 4 * k))
         self._mu = threading.Lock()  # guards sketch + counters
-        self.sketch = HeavyHitterSketch(k=k, width=width)
-        self.phases = PhaseLedger()
+        self.sketch = HeavyHitterSketch(k=k, width=width)  # guarded-by: self._mu
+        self.phases = PhaseLedger()  # internally locked (own _mu)
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_cap)
-        self._waves = 0
-        self._dropped = 0
+        self._waves = 0  # guarded-by: self._mu
+        self._dropped = 0  # guarded-by: self._mu
         self._pub_mu = threading.Lock()  # serializes gauge refreshes
-        self._published: Dict[str, float] = {}
-        self._last_publish = 0.0
+        self._published: Dict[str, float] = {}  # guarded-by: self._pub_mu
+        self._last_publish = 0.0  # guarded-by: self._pub_mu
         self._closing = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="key-analytics")
@@ -569,14 +569,21 @@ class KeyAnalytics:
 
     def _maybe_publish(self) -> None:
         now = time.monotonic()
-        if now - self._last_publish >= self.PUBLISH_INTERVAL_S:
-            self._last_publish = now
+        with self._pub_mu:
+            # check-then-set under the lock: the scrape thread's
+            # republish() writes the same stamp (guarded-by sweep found
+            # this as a racy double-publish window)
+            due = now - self._last_publish >= self.PUBLISH_INTERVAL_S
+            if due:
+                self._last_publish = now
+        if due:
             self._publish()
 
     def republish(self) -> None:
         """Scrape-time gauge refresh (daemon /metrics handler): the
         label churn costs the scraper, never the analytics worker."""
-        self._last_publish = time.monotonic()
+        with self._pub_mu:
+            self._last_publish = time.monotonic()
         self._publish()
 
     def _publish(self) -> None:

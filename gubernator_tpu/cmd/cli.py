@@ -256,13 +256,14 @@ def main(argv=None) -> int:
             with lock:
                 lats.append(dt * 1000)
 
-    threads = [threading.Thread(target=worker, args=(w,))
+    threads = [threading.Thread(target=worker, args=(w,),
+                                name=f"cli-bench-{w}")
                for w in range(args.concurrency)]
     t_start = time.monotonic()
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        t.join(timeout=600)
     elapsed = time.monotonic() - t_start
 
     total = sum(counts)

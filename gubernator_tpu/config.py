@@ -20,6 +20,104 @@ from .types import PeerInfo
 
 log = logging.getLogger("gubernator_tpu")
 
+#: The GUBER_* environment-variable registry: every env var the code
+#: reads, with a one-line operator description.  guberlint's ``envreg``
+#: pass enforces it both ways (a read without an entry and an entry
+#: without a read are both violations), and tools/check_metrics.py
+#: lints the prose docs against it — so the operator surface can never
+#: drift from the code.  Keep entries alphabetized.
+ENV_REGISTRY: Dict[str, str] = {
+    "GUBER_ADMISSION_LIMIT": "dispatcher ingress bound in rows; 0 disables (default 8×max_wave)",
+    "GUBER_ADVERTISE_ADDRESS": "address peers should dial for this daemon",
+    "GUBER_ANALYTICS": "0 disables the key-analytics subsystem (sketch + phase ledger)",
+    "GUBER_BATCH_LIMIT": "max requests per peer-forward batch",
+    "GUBER_BATCH_ROWS": "device batch rows per shard (B)",
+    "GUBER_BATCH_TIMEOUT": "peer-forward batch RPC timeout (duration)",
+    "GUBER_BATCH_WAIT": "peer-forward batch coalescing wait (duration)",
+    "GUBER_BENCH_B": "bench: device-batch size override",
+    "GUBER_BENCH_CAP": "bench: table capacity override",
+    "GUBER_BENCH_EXPECT_BACKEND": "bench: fail unless jax backend matches",
+    "GUBER_BENCH_FAST": "bench: fast mode (fewer reps, smaller shapes)",
+    "GUBER_BENCH_INNER": "bench: marks the re-exec'd child process",
+    "GUBER_BENCH_KEYS": "bench: key-cardinality override",
+    "GUBER_BENCH_NO_PALLAS": "bench: skip Pallas sections",
+    "GUBER_BENCH_PARTIAL": "bench: emit partial BENCH row on timeout salvage",
+    "GUBER_BENCH_SCAN": "bench: occupancy-scan section toggle",
+    "GUBER_BENCH_SECTION": "bench: run only this section",
+    "GUBER_BENCH_SECTION_OUT": "bench: per-section checkpoint JSON path",
+    "GUBER_BENCH_SECTION_TIMEOUT": "bench: per-section timeout seconds",
+    "GUBER_BENCH_SKIP_FILE": "bench: file listing sections to skip",
+    "GUBER_BENCH_SKIP_GROUP": "bench: skip the group-spread check",
+    "GUBER_BENCH_STEP_MODE": "bench: step-impl mode for the step sections",
+    "GUBER_BENCH_TIMEOUT": "bench: whole-run watchdog seconds",
+    "GUBER_CACHE_AUTOGROW_MAX": "auto-grow ceiling in TOTAL table rows; 0 disables",
+    "GUBER_CACHE_SIZE": "table capacity per shard",
+    "GUBER_CAP_AB_ANY_BACKEND": "tools/cap_ab: allow non-TPU backends",
+    "GUBER_CLIENT_ADDRESS": "HTTP client-facing listen address",
+    "GUBER_COALESCE_US": "dispatcher coalescing window in µs (0 disables the wait)",
+    "GUBER_CREATED_AT_FWD": "0 disables caller-clock forwarding (created_at stamp) — pre-fix cold-key-loss demo ONLY",
+    "GUBER_DATA_CENTER": "data-center name for DC-aware picking",
+    "GUBER_DNS_FQDN": "DNS discovery: FQDN to resolve for peers",
+    "GUBER_DNS_RESOLVE_INTERVAL": "DNS discovery: re-resolve interval (duration)",
+    "GUBER_DRAIN_GRACE": "graceful-shutdown drain budget (duration); bounds every drain join",
+    "GUBER_ETCD_ENDPOINTS": "etcd discovery: comma-separated endpoints",
+    "GUBER_ETCD_PREFIX": "etcd discovery: key prefix for peer registration",
+    "GUBER_EXTRAS_SMOKE": "tools/tpu_session: run the extras smoke block",
+    "GUBER_FAULT": "fault-injection spec point[@tag]:mode[:arg[:prob]],... (faults.py)",
+    "GUBER_FAULT_SEED": "fault-injection RNG seed for bit-for-bit chaos replay",
+    "GUBER_GLOBAL_BATCH_LIMIT": "GLOBAL hit-flush batch limit",
+    "GUBER_GLOBAL_BROADCAST_INTERVAL": "GLOBAL owner-broadcast tick interval (duration)",
+    "GUBER_GLOBAL_SYNC_WAIT": "GLOBAL hit-flush coalescing wait (duration)",
+    "GUBER_GLOBAL_TIMEOUT": "GLOBAL flush RPC timeout (duration)",
+    "GUBER_GRPC_ADDRESS": "gRPC listen address",
+    "GUBER_HANDOVER_ON_RESHARD": "stream moved rows to new owners on SetPeers",
+    "GUBER_HTTP_ADDRESS": "HTTP (metrics/debug) listen address",
+    "GUBER_INSTANCE_ID": "stable instance id (defaults to advertise address)",
+    "GUBER_JAX_PLATFORM": "force the jax platform (cpu/tpu) before first import",
+    "GUBER_K8S_INSECURE": "k8s discovery: skip API-server cert verification",
+    "GUBER_K8S_NAMESPACE": "k8s discovery: namespace to watch",
+    "GUBER_K8S_POD_SELECTOR": "k8s discovery: pod label selector",
+    "GUBER_K8S_SERVICE": "k8s discovery: service name whose endpoints are peers",
+    "GUBER_KSPLIT": "device step: probe K-split override (core/step.py)",
+    "GUBER_LOG_LEVEL": "root log level",
+    "GUBER_MEMBERLIST_KNOWN_HOSTS": "memberlist discovery: seed hosts",
+    "GUBER_MULTI_REGION_BATCH_LIMIT": "cross-region replication batch limit",
+    "GUBER_MULTI_REGION_SYNC_WAIT": "cross-region flush coalescing wait (duration)",
+    "GUBER_MULTI_REGION_TIMEOUT": "cross-region flush RPC timeout (duration)",
+    "GUBER_NATIVE_SAN": "setup_native.py: build _native under tsan/asan (make tsan / make asan)",
+    "GUBER_PALLAS_PROBE_OUT": "tools/pallas_probe: checkpoint JSON path",
+    "GUBER_PALLAS_SWEEP": "1/0 force the fused Pallas sweep on/off (default: TPU only)",
+    "GUBER_PEERS": "static peer list (host:port,... ) for static discovery",
+    "GUBER_PEERS_FILE": "file-based discovery: path to the peer list",
+    "GUBER_PEER_DEGRADED_FALLBACK": "0 restores legacy error rows instead of degraded serves",
+    "GUBER_PEER_DISCOVERY_TYPE": "peer discovery backend (static/file/dns/etcd/k8s/memberlist)",
+    "GUBER_PEER_EJECT_AFTER": "circuit-open streak before ring ejection (duration)",
+    "GUBER_PEER_HEALTH_GATE": "0 disables the health-gated routing ring",
+    "GUBER_PEER_READMIT_AFTER": "recovered time before an ejected peer readmits (duration)",
+    "GUBER_PIPELINE": "1/0 force the launch/sync wave pipeline on/off (default: TPU only)",
+    "GUBER_PIPELINE_DEPTH": "in-flight launched waves in the pipeline (min 1)",
+    "GUBER_PROBES": "device step: open-addressing probe count (core/step.py)",
+    "GUBER_PROFILE_DIR": "on-demand device-profiler capture directory",
+    "GUBER_RESULT_TIMEOUT_S": "caller wave-result timeout seconds (finite, > 0)",
+    "GUBER_SESSION_BENCH_TIMEOUT": "tools/tpu_session: bench stage timeout seconds",
+    "GUBER_SESSION_EXTRAS_OUT": "tools/tpu_session: extras checkpoint JSON path",
+    "GUBER_SKETCH_WIDTH": "heavy-hitter sketch counter width (default 4×TOPK)",
+    "GUBER_SNAPSHOT_PATH": "Loader snapshot path (save on close, load on start)",
+    "GUBER_STALL_THRESHOLD_S": "wave stall-watchdog threshold seconds; <=0 disables",
+    "GUBER_STEP_DONATE": "0 disables donated (aliased) step buffers",
+    "GUBER_STEP_IMPL": "device step implementation (xla/pallas)",
+    "GUBER_TLS_AUTO": "generate a self-signed TLS setup at startup",
+    "GUBER_TLS_CA": "TLS CA bundle path",
+    "GUBER_TLS_CERT": "TLS server certificate path",
+    "GUBER_TLS_CLIENT_AUTH": "TLS client-auth mode",
+    "GUBER_TLS_CLIENT_AUTH_CA_CERT": "TLS client-auth CA path",
+    "GUBER_TLS_INSECURE_SKIP_VERIFY": "peer clients skip TLS verification",
+    "GUBER_TLS_KEY": "TLS server key path",
+    "GUBER_TOPK": "heavy-hitter sketch tracked-key count K",
+    "GUBER_WAVE_BUCKETS": "comma-separated wave-size buckets for check_packed",
+    "GUBER_XLA_CPU_TUNE": "0 skips the XLA:CPU thunk-runtime opt-out at import",
+}
+
 _DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DUR_UNIT_MS = {"ns": 1e-6, "us": 1e-3, "µs": 1e-3, "ms": 1.0,
                 "s": 1000.0, "m": 60_000.0, "h": 3_600_000.0}

@@ -142,13 +142,14 @@ class WaveBufferPool:
         import threading
 
         self._mu = threading.Lock()
-        self._free: dict[int, list] = {}  # m → [(a64, a32), ...]
+        #: m → [(a64, a32), ...]
+        self._free: dict[int, list] = {}  # guarded-by: self._mu
         self.max_per_width = (max_per_width if max_per_width is not None
                               else self.MAX_PER_WIDTH)
-        self.hits = 0
-        self.misses = 0
-        self.leaks = 0
-        self.outstanding = 0
+        self.hits = 0  # guarded-by: self._mu
+        self.misses = 0  # guarded-by: self._mu
+        self.leaks = 0  # guarded-by: self._mu
+        self.outstanding = 0  # guarded-by: self._mu
         self.metrics = None  # bound by V1Instance after construction
 
     def lease(self, m: int) -> WaveLease:
@@ -246,6 +247,11 @@ def pack_requests(
     GREG = int(Behavior.DURATION_IS_GREGORIAN)  # hot loop: plain-int flags
     b.now[:n] = now_ms
     for i, r in enumerate(reqs):
+        if r.created_at:
+            # caller's accepted-at clock (forward hop, types.py): the
+            # request applies at ITS time base, so a key served through
+            # two daemons never mixes bases in one bucket row
+            b.now[i] = r.created_at
         behavior = int(r.behavior)
         leaky = int(r.algorithm) == 1
         duration = min(int(r.duration), DURATION_MAX)
@@ -291,6 +297,7 @@ def pack_columns(
     behavior: np.ndarray,
     burst: np.ndarray,
     now_ms: int,
+    created_at: np.ndarray | None = None,
 ) -> tuple[RequestBatch, dict]:
     """Vectorized pack of already-columnar requests (the C++ wire-ingest
     lane, ops/_native.cpp › parse_get_rate_limits) → RequestBatch.
@@ -299,6 +306,12 @@ def pack_columns(
     — no per-request Python.  Returns (batch, errors) where errors maps
     request index → error string (invalid Gregorian ordinals, as on the
     pb2 path).  ``khash`` must already be mixed and zero-remapped.
+
+    ``created_at`` (optional i64[n], 0 = unset) is the caller's
+    accepted-at clock from the forward hop: rows carrying it take it as
+    their ``now`` so they apply at the CALLER's time base (Gregorian
+    period ends still derive from ``now_ms`` — calendar rows never ride
+    the forward stamp).
     """
     n = len(khash)
     behavior32 = behavior.astype(np.int32)
@@ -330,6 +343,10 @@ def pack_columns(
     cap_v = np.where(leaky, np.minimum(TD_BOUND // eff, VALUE_MAX),
                      VALUE_MAX)
     lim = np.minimum(np.clip(np.asarray(limit, np.int64), 0, None), cap_v)
+    now_col = np.full(n, now_ms, np.int64)
+    if created_at is not None:
+        created = np.asarray(created_at, np.int64)
+        now_col = np.where(created > 0, created, now_col)
     b = RequestBatch(
         key=key_col,
         hits=np.minimum(np.clip(np.asarray(hits, np.int64), 0, None), cap_v),
@@ -341,6 +358,6 @@ def pack_columns(
         algorithm=leaky.astype(np.int32),
         burst=np.where(burst > 0, np.minimum(burst, cap_v), lim),
         valid=valid,
-        now=np.full(n, now_ms, np.int64),
+        now=now_col,
     )
     return b, errors

@@ -43,9 +43,9 @@ class Discovery:
 
     def __init__(self, on_change: OnChange):
         self._on_change = on_change
-        self._last: Optional[tuple] = None
+        self._last: Optional[tuple] = None  # guarded-by: self._notify_mu
         self._notify_mu = threading.Lock()
-        self._discovery_closed = False
+        self._discovery_closed = False  # guarded-by: self._notify_mu
 
     def _notify(self, peers: Sequence[PeerInfo]) -> None:
         key = tuple(sorted((p.grpc_address, p.http_address, p.datacenter)
@@ -204,11 +204,11 @@ class GossipDiscovery(Discovery):
         self.indirect_probes = indirect_probes
         #: gossip_addr → (PeerInfo dict, last_seen monotonic); guarded by
         #: _members_mu (written by the rx thread, read by the tx tick).
-        self._members: dict = {}
+        self._members: dict = {}  # guarded-by: self._members_mu
         #: gossip_addr → eviction monotonic time: rejoin-probe targets
         #: (same lock).  Bounded by dead_retain_s so a long-gone address
         #: doesn't collect datagrams forever.
-        self._dead: dict = {}
+        self._dead: dict = {}  # guarded-by: self._members_mu
         self.dead_retain_s = (dead_retain_ms / 1000.0
                               if dead_retain_ms is not None
                               else 30 * self.dead_s)

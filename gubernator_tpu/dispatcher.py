@@ -206,20 +206,21 @@ class Dispatcher:
         self._clock = clock
         # --- wave telemetry state (all under _tel_mu) ---
         self._tel_mu = threading.Lock()
-        self._inflight: dict = {}  # wave_id → {t0, kind, size, trace, stalled}
-        self._wave_seq = 0
-        self._wave_count = 0
-        self._stall_count = 0
-        self._timeout_count = 0
-        self._first_wave_s: Optional[float] = None
-        self._last_wave_end: Optional[float] = None
+        #: wave_id → {t0, kind, size, trace, stalled}
+        self._inflight: dict = {}  # guarded-by: self._tel_mu
+        self._wave_seq = 0  # guarded-by: self._tel_mu
+        self._wave_count = 0  # guarded-by: self._tel_mu
+        self._stall_count = 0  # guarded-by: self._tel_mu
+        self._timeout_count = 0  # guarded-by: self._tel_mu
+        self._first_wave_s: Optional[float] = None  # guarded-by: self._tel_mu
+        self._last_wave_end: Optional[float] = None  # guarded-by: self._tel_mu
         from collections import deque as _deque
 
         #: bounded recent-wave samples for telemetry_snapshot percentiles
         #: (prometheus histograms can't answer percentile queries)
-        self._recent_sizes: "_deque" = _deque(maxlen=4096)
-        self._recent_durs: "_deque" = _deque(maxlen=4096)
-        self._recent_waits: "_deque" = _deque(maxlen=4096)
+        self._recent_sizes: "_deque" = _deque(maxlen=4096)  # guarded-by: self._tel_mu
+        self._recent_durs: "_deque" = _deque(maxlen=4096)  # guarded-by: self._tel_mu
+        self._recent_waits: "_deque" = _deque(maxlen=4096)  # guarded-by: self._tel_mu
         #: Shared with the instance's row-level ops (gather/upsert/
         #: restore/sweep), which run on other threads and mutate the
         #: same engine state.
@@ -243,10 +244,12 @@ class Dispatcher:
                                     * self.max_wave)
         except ValueError:
             self.admission_limit = self.ADMISSION_LIMIT_WAVES * self.max_wave
-        self._queued_rows = 0
+        self._queued_rows = 0  # guarded-by: self._submit_mu
+        #: drain flag: single racy bool write in drain(), lock-free reads
         self._draining = False
-        self._shed_rows = 0
-        self._last_shed_event = 0.0  # recorder rate limit (1/s/reason)
+        self._shed_rows = 0  # guarded-by: self._submit_mu
+        #: recorder rate limit (1/s/reason)
+        self._last_shed_event = 0.0  # guarded-by: self._submit_mu
         #: one idle-path inline runner at a time (see _try_inline)
         self._inline_mu = threading.Lock()
         #: pipelining needs BOTH the policy and the engine capability —
@@ -468,12 +471,12 @@ class Dispatcher:
         if self.recorder is not None and not throttled:
             # rate-limited: under sustained overload one event per
             # second, not one per rejected call
-            self.recorder.record("admission_shed", reason=reason,
-                                 rows=nrows,
-                                 queued_rows=self._queued_rows)
+            self.recorder.record(
+                "admission_shed", reason=reason, rows=nrows,
+                queued_rows=self._queued_rows)  # lock-free: diagnostic snapshot
         raise ResourceExhausted(
             f"admission control shed {nrows} requests ({reason}: "
-            f"queued_rows={self._queued_rows}, "
+            f"queued_rows={self._queued_rows}, "  # lock-free: diagnostic snapshot
             f"limit={self.admission_limit})")
 
     def projected_queue_wait_s(self, extra_rows: int = 0) -> float:
@@ -484,6 +487,7 @@ class Dispatcher:
         ISSUE 4), falling back to the recent-wave deques; an empty
         queue projects 0 — your wave launches immediately."""
         with self._tel_mu:
+            # lock-free: projection input; a racy row read costs one wave of estimate error
             queued = self._queued_rows + extra_rows
             sizes = list(self._recent_sizes)
             durs = list(self._recent_durs)
@@ -518,11 +522,11 @@ class Dispatcher:
         if self._draining:
             self._shed("draining", nrows)
         lim = self.admission_limit
-        if lim and self._queued_rows + nrows > lim:
+        if lim and self._queued_rows + nrows > lim:  # lock-free: GIL-atomic int read; admit is approximate by design
             self._shed("queue_full", nrows)
         dl = deadline_s if deadline_s is not None \
             else _REQUEST_DEADLINE.get()
-        if dl is not None and dl > 0 and self._queued_rows > 0:
+        if dl is not None and dl > 0 and self._queued_rows > 0:  # lock-free: GIL-atomic int read; admit is approximate by design
             # wait = draining what's AHEAD of this batch; its own
             # service time is not queue wait
             if self.projected_queue_wait_s(0) > dl:
@@ -815,6 +819,7 @@ class Dispatcher:
             # overload admission control (ISSUE 5): ingress bound,
             # rows currently inside it, rows shed, drain state
             "admission": {"limit_rows": self.admission_limit,
+                          # lock-free: healthz snapshot, staleness ok
                           "queued_rows": self._queued_rows,
                           "shed_rows": self._shed_rows,
                           "draining": self._draining,
@@ -911,9 +916,29 @@ class Dispatcher:
                 # pure waste.  The job that would overflow leads the
                 # NEXT wave instead.
                 self._carry = job
+                try:
+                    # racer preemption point: delay parks the carried
+                    # job across the wave boundary; error drops it
+                    # (future failed, never launched)
+                    self._fault("dispatch_carry")
+                except Exception as e:  # noqa: BLE001 - injected only
+                    self._carry = None
+                    if not job.future.done():
+                        job.future.set_exception(e)
                 break
             wave.append(job)
             total += _job_len(job)
+        if wave:
+            try:
+                # racer preemption point: a delay here widens the window
+                # between collecting this wave and launching it, so
+                # concurrent lanes land in the NEXT wave/engine call
+                self._fault("dispatch_merge")
+            except Exception as e:  # noqa: BLE001 - injected only
+                for j in wave:
+                    if not j.future.done():
+                        j.future.set_exception(e)
+                return []
         return wave
 
     def _run(self) -> None:
@@ -1032,6 +1057,9 @@ class Dispatcher:
             cols = self.engine.sync_packed(
                 token, engine_lock=self._engine_lock)
             self._wave_mark(wid, "device")
+            # racer preemption point: hold the result splice while later
+            # waves launch (callers still waiting on their views)
+            self._fault("dispatch_splice")
             a = 0
             for j in jobs:
                 b = a + len(j.khash)
@@ -1090,6 +1118,7 @@ class Dispatcher:
             st, lim, rem, rst, full = self.engine.check_packed(
                 batch, khash, now)
         self._wave_mark(wid, "device")
+        self._fault("dispatch_splice")
         a = 0
         cols = (st, lim, rem, rst, full)
         for j, _, kh, errs in parts:
@@ -1120,6 +1149,7 @@ class Dispatcher:
                 self._fault("device_step")
                 resps = self.engine.check_batch(merged, now)
             self._wave_mark(wid, "device")
+            self._fault("dispatch_splice")
             for j, a, b in slices:
                 j.future.set_result(resps[a:b])
             self._wave_end(wid)
@@ -1151,6 +1181,7 @@ class Dispatcher:
                 self._fault("device_step")
                 cols = self.engine.check_packed(batch, khash, now)
             self._wave_mark(wid, "device")
+            self._fault("dispatch_splice")
             a = 0
             for j in jobs:
                 b = a + len(j.khash)

@@ -70,6 +70,17 @@ FAULT_POINTS = {
                        "of a queued wave",
     "dispatch_sync": "Dispatcher._sync_and_resolve — before a pipelined "
                      "wave's sync",
+    "dispatch_merge": "Dispatcher._drain_wave — after a wave's jobs are "
+                      "collected, before the merge/launch (delay mode "
+                      "widens the window for more callers to land in "
+                      "the NEXT wave — the racer's preemption point)",
+    "dispatch_carry": "Dispatcher._drain_wave — when an overflow job is "
+                      "held as the next wave's carry (delay mode parks "
+                      "the carried job across the wave boundary)",
+    "dispatch_splice": "Dispatcher result splicing — after the engine "
+                       "call, before per-job futures resolve from the "
+                       "shared result columns (delay mode holds "
+                       "responses while later waves launch)",
     "device_step": "the engine call itself (inline and queued waves)",
     "wire_ingest": "instance wire entry — before the C++ parse",
     "global_broadcast": "GlobalManager._run_broadcasts — before the "

@@ -57,23 +57,23 @@ class GlobalManager:
         #: the prototype with the highest seq wins the flush-time merge
         #: — "latest config wins" must hold across lanes, not just
         #: within one
-        self._seq = 0
+        self._seq = 0  # guarded-by: self._mu
         #: key → (request prototype, accumulated hits, seq) — non-owner.
-        self._hits: Dict[str, Tuple[RateLimitRequest, int, int]] = {}
+        self._hits: Dict[str, Tuple[RateLimitRequest, int, int]] = {}  # guarded-by: self._mu
         #: key → (seq, request prototype) for changed GLOBAL keys —
         #: owner side.
-        self._updates: Dict[str, Tuple[int, RateLimitRequest]] = {}
+        self._updates: Dict[str, Tuple[int, RateLimitRequest]] = {}  # guarded-by: self._mu
         #: key-hash → (request TLV bytes, accumulated hits, seq) — the
         #: wire lane's non-owner side.  The columnar request path queues
         #: the raw `requests` TLV slice instead of building per-request
         #: objects; entries materialize into prototypes at flush
         #: cadence (_req_from_tlv) and merge into _hits.
-        self._hits_raw: Dict[int, Tuple[bytes, int, int]] = {}
+        self._hits_raw: Dict[int, Tuple[bytes, int, int]] = {}  # guarded-by: self._mu
         #: key-hash → (seq, request TLV bytes) — wire lane, owner side.
-        self._updates_raw: Dict[int, Tuple[int, bytes]] = {}
+        self._updates_raw: Dict[int, Tuple[int, bytes]] = {}  # guarded-by: self._mu
         self._err_mu = threading.Lock()
-        self._last_error = ""
-        self._last_error_at = 0.0
+        self._last_error = ""  # guarded-by: self._err_mu
+        self._last_error_at = 0.0  # guarded-by: self._err_mu
         self._hits_loop = IntervalLoop(
             behaviors.global_sync_wait_ms, self._run_async_hits,
             name="global-async-hits")

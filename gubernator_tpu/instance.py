@@ -17,6 +17,7 @@ import os
 import threading
 import time
 from concurrent.futures import Future
+from dataclasses import replace
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -55,6 +56,16 @@ try:  # C++ wire-ingest lane (ops/_native.cpp); optional
 except ImportError:  # pragma: no cover - unbuilt extension
     _wire_native = None
 
+
+
+def _created_at_fwd_enabled() -> bool:
+    """GUBER_CREATED_AT_FWD=0 disables caller-clock forwarding (the
+    created_at stamp on forwarded TLVs and deferred hit queues) —
+    restoring the pre-fix behavior where every hop applies requests at
+    its own wall clock.  Exists so tools/racer.py and the conservation
+    regression tests can demonstrate the cold-key loss the stamp fixes;
+    never disable it in production."""
+    return os.environ.get("GUBER_CREATED_AT_FWD", "1") != "0"
 
 def clock_ms() -> int:
     return time.time_ns() // 1_000_000
@@ -171,9 +182,9 @@ class V1Instance:
         # Datacenter-aware deployments route through a region picker
         # (region_picker.go); single-region uses the flat ring.
         if config.data_center:
-            self._picker = RegionPeerPicker(config.data_center)
+            self._picker = RegionPeerPicker(config.data_center)  # guarded-by: self._peer_mu
         else:
-            self._picker = ReplicatedConsistentHash()
+            self._picker = ReplicatedConsistentHash()  # guarded-by: self._peer_mu
         self._peer_mu = threading.Lock()
         self._self_addr = config.advertise_address
         # Health-gated routing ring (ISSUE 5): peers whose circuit has
@@ -181,9 +192,11 @@ class V1Instance:
         # routing picker (their keys deterministically rehome to the
         # next ring point) and readmitted only after staying recovered
         # for peer_readmit_after_ms.  All under _peer_mu.
+        #: lock-free reads are fine (immutable frozenset swap); all
+        #: WRITES and read-modify-write derivations hold _peer_mu
         self._gate_bad: frozenset = frozenset()
-        self._gate_picker = None
-        self._ring_gen = 0
+        self._gate_picker = None  # guarded-by: self._peer_mu
+        self._ring_gen = 0  # guarded-by: self._peer_mu
         #: IntervalLoop probing EJECTED peers (rehomed keys carry no
         #: organic traffic, so nothing else would half-open their
         #: circuit); started lazily on first ejection
@@ -195,14 +208,15 @@ class V1Instance:
         # lazily built on first promotion; pod-local only.
         self._hotset = None
         self._hot_mu = threading.Lock()
-        self._hot_counts: Dict[int, int] = {}  # key_hash → weight
+        #: key_hash → weight
+        self._hot_counts: Dict[int, int] = {}  # guarded-by: self._hot_mu
         self._hot_sync_loop = None
         self._promote_pending: List[tuple] = []
         # stateful-handover serialization: one pass at a time, and a
         # generation counter so a newer membership change supersedes an
         # in-flight pass (it re-snapshots whatever is left)
         self._handover_mu = threading.Lock()
-        self._handover_gen = 0
+        self._handover_gen = 0  # guarded-by: self._handover_gen_mu
         self._handover_gen_mu = threading.Lock()
         self._closed = False
         self._last_sweep = clock_ms()
@@ -277,7 +291,8 @@ class V1Instance:
             self.metrics.ring_generation.set(self._ring_gen)
             self.metrics.ring_ejected_peers.set(0)
         for departed in old.values():
-            threading.Thread(target=departed.shutdown, daemon=True).start()
+            threading.Thread(target=departed.shutdown, daemon=True,
+                             name="peer-shutdown").start()
         # The hot-set psum tier is pod-local: once any non-self peer
         # exists (hot routing turns off), hot keys must go back to
         # daemon-level ownership with their consumption intact.
@@ -295,7 +310,7 @@ class V1Instance:
                 gen = self._handover_gen
             threading.Thread(target=self._handover_moved_rows,
                              args=(old_picker, gen),
-                             daemon=True).start()
+                             daemon=True, name="handover").start()
 
     @staticmethod
     def _uses_default_hash(picker) -> bool:
@@ -556,7 +571,7 @@ class V1Instance:
                 hgen = self._handover_gen
             threading.Thread(target=self._handover_moved_rows,
                              args=(old_routing, hgen),
-                             daemon=True).start()
+                             daemon=True, name="handover-rehome").start()
         return gated if gated is not None else picker
 
     def _route_owner_of(self, key: str) -> Optional[PeerClient]:
@@ -738,7 +753,8 @@ class V1Instance:
                     def runner(inner=inner):
                         out = inner()
                         # after the step: rows exist, replicate async
-                        self._queue_mr_raw(parsed, data, mr_mask)
+                        self._queue_mr_raw(parsed, data, mr_mask,
+                                           stamp_ms=now)
                         return out
                 else:
                     runner = inner
@@ -987,13 +1003,15 @@ class V1Instance:
         if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
             mr = (parsed["behavior"]
                   & int(Behavior.MULTI_REGION)) != 0
-            self._queue_mr_raw(parsed, data, mr)
+            self._queue_mr_raw(parsed, data, mr, stamp_ms=now)
         if gate_rehome:
-            out = self._peer_degraded_rewrite(parsed, data, out)
+            out = self._peer_degraded_rewrite(parsed, data, out,
+                                              stamp_ms=now)
         return out
 
     def _peer_degraded_rewrite(self, parsed: dict, data: bytes,
-                               out: bytes) -> bytes:
+                               out: bytes,
+                               stamp_ms: Optional[int] = None) -> bytes:
         """Rehome-target side of degraded mode (ISSUE 5): a forwarded
         row whose MEMBERSHIP owner is ejected from our health gate was
         routed here by another daemon's gated ring.  Its local apply
@@ -1028,7 +1046,8 @@ class V1Instance:
         if not mask.any():
             return out
         gm = self._ensure_global_manager()
-        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask):
+        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask,
+                                                    stamp_ms=stamp_ms):
             gm.queue_hits_raw(k, tlv, a)
         # flag the masked rows: re-serialize just those items with the
         # degraded metadata (pb2 — metadata has no C++ lane; this path
@@ -1057,15 +1076,26 @@ class V1Instance:
         return b"".join(items)
 
     @staticmethod
-    def _raw_queue_groups(parsed: dict, data: bytes, mask: np.ndarray):
+    def _raw_queue_groups(parsed: dict, data: bytes, mask: np.ndarray,
+                          stamp_ms: Optional[int] = None):
         """(khash, last-occurrence TLV, summed hits, last row index)
         per unique masked key — the shared aggregation for the raw
         async queues (LAST occurrence: a mid-batch config change must
-        win, matching the object-path producers)."""
+        win, matching the object-path producers).
+
+        ``stamp_ms`` stamps ``created_at`` (field 10) onto yielded TLVs
+        that don't already carry one: hit-queue prototypes apply at the
+        owner LATER (flush/reconcile cadence), and applying them at the
+        owner's then-clock on a row living on the request's time base
+        reads as expired → bucket reset → the reconciled hits silently
+        vanish (the cold-key conservation loss, reconcile edition)."""
         idx = np.nonzero(mask)[0]
         if not idx.size:
             return
+        from .wire import tlv_with_created
+
         toff, tlen = parsed["tlv_off"], parsed["tlv_len"]
+        created = parsed["created_at"]
         w = np.maximum(parsed["hits"][idx], 0)
         uniq, inv = np.unique(parsed["khash_raw"][idx],
                               return_inverse=True)
@@ -1076,19 +1106,24 @@ class V1Instance:
         np.add.at(acc, inv, w)
         last = np.zeros(uniq.size, np.int64)
         last[inv] = np.arange(inv.size)
+        stamping = _created_at_fwd_enabled()
         for k, f, a in zip(uniq, last, acc):
             i = int(idx[int(f)])
-            yield (int(k),
-                   bytes(data[int(toff[i]):int(toff[i] + tlen[i])]),
-                   int(a), i)
+            tlv = bytes(data[int(toff[i]):int(toff[i] + tlen[i])])
+            if stamping and stamp_ms is not None \
+                    and not int(created[i]):
+                tlv = tlv_with_created(tlv, stamp_ms)
+            yield (int(k), tlv, int(a), i)
 
     def _queue_mr_raw(self, parsed: dict, data: bytes,
-                      mask: np.ndarray) -> None:
+                      mask: np.ndarray,
+                      stamp_ms: Optional[int] = None) -> None:
         """Queue cross-region replication for locally-decided
         MULTI_REGION rows, zero per-request objects (the wire-lane twin
         of the object path's mr.queue_hits calls)."""
         mr = self._ensure_mr_manager()
-        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask):
+        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask,
+                                                    stamp_ms=stamp_ms):
             mr.queue_hits_raw(k, tlv, a)
 
     def _queue_global_updates_raw(self, parsed: dict, data: bytes,
@@ -1123,7 +1158,8 @@ class V1Instance:
         kh = np.where(kh == 0, np.uint64(1), kh)
         batch, errs = pack_columns(
             kh, parsed["hits"], parsed["limit"], parsed["duration"],
-            parsed["algorithm"], parsed["behavior"], parsed["burst"], now)
+            parsed["algorithm"], parsed["behavior"], parsed["burst"], now,
+            created_at=parsed.get("created_at"))
         beh = np.asarray(batch.behavior)
         glob_mask = (beh & int(Behavior.GLOBAL)) != 0
         excluded = (beh & int(self._HOT_EXCLUDED)) != 0
@@ -1223,8 +1259,8 @@ class V1Instance:
         return run
 
     def _packed_check_to_bytes(self, kh: np.ndarray, hits, limit, duration,
-                               algorithm, behavior, burst, now: int
-                               ) -> bytes:
+                               algorithm, behavior, burst, now: int,
+                               created=None) -> bytes:
         """Columns → pack → device step → response wire bytes: the
         shared fast-lane body (solo client wire, peer wire, and the
         clustered lane's local sub-batch all end here).  Resolves from
@@ -1237,7 +1273,8 @@ class V1Instance:
         from .core.batch import pack_columns
 
         batch, errs = pack_columns(kh, hits, limit, duration, algorithm,
-                                   behavior, burst, now)
+                                   behavior, burst, now,
+                                   created_at=created)
         view = self.dispatcher.check_packed_view(batch, kh, now)
         status = view.cols[0][view.lo:view.hi]
         full = view.cols[4][view.lo:view.hi]
@@ -1267,7 +1304,8 @@ class V1Instance:
         kh = np.where(kh == 0, np.uint64(1), kh)
         return self._packed_check_to_bytes(
             kh, parsed["hits"], parsed["limit"], parsed["duration"],
-            parsed["algorithm"], parsed["behavior"], parsed["burst"], now)
+            parsed["algorithm"], parsed["behavior"], parsed["burst"], now,
+            created=parsed.get("created_at"))
 
     def _wire_check_clustered(self, parsed: dict, data: bytes, now: int
                               ) -> bytes:
@@ -1342,7 +1380,7 @@ class V1Instance:
             # queue keys — the same key space as the peer-wire
             # producers — with last-occurrence TLV prototypes
             for k, tlv, a, i in self._raw_queue_groups(
-                    parsed, data, glob_mask):
+                    parsed, data, glob_mask, stamp_ms=now):
                 glob_queue.append(
                     (k, tlv, a, int(owners[i]) in self_pi))
             local_mask = local_mask | glob_mask
@@ -1360,7 +1398,7 @@ class V1Instance:
         # in flight; a dead peer fails fast via ErrCircuitOpen instead
         # of queuing every caller behind its timeouts.  The TLV slices
         # join through ONE memoryview (no per-slice bytes copies).
-        mv = memoryview(data)
+        created = parsed["created_at"]
         groups = []
         for pi in np.unique(owners[~local_mask]):
             # ~local_mask also excludes GLOBAL rows that share an owner
@@ -1368,8 +1406,18 @@ class V1Instance:
             # reconcile asynchronously — forwarding them too would
             # double-debit the owner
             idxs = np.nonzero((owners == pi) & ~local_mask)[0]
-            sub = b"".join(
-                mv[int(toff[i]):int(toff[i] + tlen[i])] for i in idxs)
+            # stamp OUR accepted-at clock (field 10) onto each slice
+            # that doesn't already carry one: the owner applies the
+            # rows at this caller's time base instead of its own wall
+            # clock — mixing bases resets cold bucket rows and loses
+            # their debits (types.RateLimitRequest.created_at)
+            if _created_at_fwd_enabled():
+                sub = _wire_native.stamp_req_tlvs(
+                    data, toff[idxs], tlen[idxs], created[idxs], now)
+            else:  # pre-fix behavior (racer/regression demos only)
+                sub = b"".join(
+                    bytes(data[int(toff[i]):int(toff[i] + tlen[i])])
+                    for i in idxs)
             fut = send_err = None
             try:
                 fut = peer_list[int(pi)].forward_raw(sub, int(idxs.size))
@@ -1405,7 +1453,8 @@ class V1Instance:
                 parsed["limit"][local_idx], parsed["duration"][local_idx],
                 parsed["algorithm"][local_idx],
                 parsed["behavior"][local_idx],
-                parsed["burst"][local_idx], now)
+                parsed["burst"][local_idx], now,
+                created=created[local_idx])
             lo, ll, _ = _wire_native.split_resp_items(lbytes)
             for j, i in enumerate(local_idx):
                 item_tlvs[int(i)] = lbytes[int(lo[j]):int(lo[j] + ll[j])]
@@ -1428,7 +1477,8 @@ class V1Instance:
                        & ((parsed["behavior"]
                            & int(Behavior.MULTI_REGION)) != 0))
             if mr_mask.any():
-                self._queue_mr_raw(parsed, data, mr_mask)
+                self._queue_mr_raw(parsed, data, mr_mask,
+                                   stamp_ms=now)
 
         # lane futures always resolve (RPC deadline + bounded retries +
         # explicit failure paths); the wait bound below is that worst
@@ -1521,7 +1571,8 @@ class V1Instance:
         batch, errs = pack_columns(
             kh[idxs], parsed["hits"][idxs], parsed["limit"][idxs],
             parsed["duration"][idxs], parsed["algorithm"][idxs],
-            parsed["behavior"][idxs], parsed["burst"][idxs], now)
+            parsed["behavior"][idxs], parsed["burst"][idxs], now,
+            created_at=parsed["created_at"][idxs])
         view = self.dispatcher.check_packed_view(batch, kh[idxs], now)
         st, lim, rem, rst, full = view.sliced()
         self.metrics.over_limit_counter.inc(int((st == 1).sum()))
@@ -1545,7 +1596,8 @@ class V1Instance:
         mask = np.zeros(parsed["n"], bool)
         mask[idxs] = True
         gm = self._ensure_global_manager()
-        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask):
+        for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask,
+                                                    stamp_ms=now):
             gm.queue_hits_raw(k, tlv, a)
         self.metrics.degraded_served.labels(peer_addr=peer_addr).inc(m)
         self.recorder.record("degraded", peer=peer_addr, rows=m)
@@ -1581,6 +1633,18 @@ class V1Instance:
             item_tlvs[int(i)] = tlvs[j]
         served[elig] = True
         return served
+
+    @staticmethod
+    def _req_stamped(req: RateLimitRequest, now: int) -> RateLimitRequest:
+        """The request with ``created_at`` defaulted to its serving
+        time base — REQUIRED before queueing it for deferred hit
+        application (GLOBAL reconcile, cross-region replication): the
+        flush applies at the owner later, and without the stamp the
+        owner's then-clock reads a row living on the request's base as
+        expired → bucket reset → the deferred hits silently vanish."""
+        if req.created_at or not _created_at_fwd_enabled():
+            return req
+        return replace(req, created_at=now)
 
     def _get_rate_limits(self, reqs, now) -> List[RateLimitResponse]:
         n = len(reqs)
@@ -1639,7 +1703,8 @@ class V1Instance:
             if not have_peers:
                 local_idx.append(i)
                 if behavior & MULTI_REGION:
-                    self._ensure_mr_manager().queue_hits(req)
+                    self._ensure_mr_manager().queue_hits(
+                        self._req_stamped(req, now))
                 continue
             try:
                 owner = rpick.get(req.key) if rpick.peers() else None
@@ -1660,13 +1725,19 @@ class V1Instance:
                         deg_local.append((i, mowner.info.grpc_address))
                 # local-region owner replicates cross-DC asynchronously
                 if behavior & MULTI_REGION:
-                    self._ensure_mr_manager().queue_hits(req)
+                    self._ensure_mr_manager().queue_hits(
+                        self._req_stamped(req, now))
             else:
                 fwd.append((i, owner, req))
 
         # forwards first (async futures), so the device step overlaps RPCs
         futures: List[tuple] = []
         for i, peer, req in fwd:
+            if not req.created_at and _created_at_fwd_enabled():
+                # stamp OUR accepted-at clock so the owner applies the
+                # request at this caller's time base (first hop wins;
+                # rides the TLV as field 10 — wire.req_to_tlv)
+                req = replace(req, created_at=now)
             if int(req.behavior) & NO_BATCHING:
                 f: Future = Future()
 
@@ -1676,7 +1747,8 @@ class V1Instance:
                     except Exception as e:  # noqa: BLE001
                         f.set_exception(e)
 
-                threading.Thread(target=_go, daemon=True).start()
+                threading.Thread(target=_go, daemon=True,
+                                 name="peer-forward-nobatch").start()
             else:
                 try:
                     f = peer.enqueue(req)
@@ -1716,7 +1788,7 @@ class V1Instance:
                     continue
                 resp.metadata["degraded"] = "true"
                 resp.metadata["degraded_peer"] = addr
-                gm.queue_hits(reqs[i])
+                gm.queue_hits(self._req_stamped(reqs[i], now))
                 self.metrics.degraded_served.labels(
                     peer_addr=addr).inc()
         if glob_q:
@@ -1725,7 +1797,7 @@ class V1Instance:
                 if own:
                     gm.queue_update(req)  # row written by the step above
                 else:
-                    gm.queue_hits(req)
+                    gm.queue_hits(self._req_stamped(req, now))
         if self._promote_pending:
             self._drain_promotions(now)
 
@@ -1763,7 +1835,7 @@ class V1Instance:
                     if not resp.error:
                         resp.metadata["degraded"] = "true"
                         resp.metadata["degraded_peer"] = addr
-                        gm.queue_hits(req)
+                        gm.queue_hits(self._req_stamped(req, now))
                         self.metrics.degraded_served.labels(
                             peer_addr=addr).inc()
                         if resp.status == Status.OVER_LIMIT:
@@ -1892,6 +1964,7 @@ class V1Instance:
         for kh in khs:
             hs.unpin(kh)
 
+    # lock-free: caller holds self._hot_mu (the *_locked suffix contract)
     def _decay_counts_locked(self) -> None:
         """Halve promotion counters, drop zeros.  Caller holds _hot_mu."""
         self._hot_counts = {k: v // 2
@@ -1992,17 +2065,18 @@ class V1Instance:
                 gm.queue_update(req)
             if req.behavior & Behavior.MULTI_REGION:
                 # we are the local-region owner for this forwarded key
-                self._ensure_mr_manager().queue_hits(req)
+                self._ensure_mr_manager().queue_hits(
+                    self._req_stamped(req, now))
         # rehome-target duty (ISSUE 5, object-path twin of
         # _peer_degraded_rewrite): rows whose membership owner is
         # ejected from OUR gate were rehomed here — flag + reconcile
         if self._gate_bad and getattr(self.config.behaviors,
                                       "peer_degraded_fallback", True):
-            self._peer_degraded_objects(reqs, resps)
+            self._peer_degraded_objects(reqs, resps, now)
         self._after_local(reqs, resps)
         return resps
 
-    def _peer_degraded_objects(self, reqs, resps) -> None:
+    def _peer_degraded_objects(self, reqs, resps, now: int) -> None:
         bad = self._gate_bad
         with self._peer_mu:
             mpick = self._picker
@@ -2023,7 +2097,7 @@ class V1Instance:
             resp.metadata["degraded"] = "true"
             resp.metadata["degraded_peer"] = addr
             gm = gm or self._ensure_global_manager()
-            gm.queue_hits(req)
+            gm.queue_hits(self._req_stamped(req, now))
             self.metrics.degraded_served.labels(peer_addr=addr).inc()
 
     # ---- GLOBAL broadcast plumbing -------------------------------------

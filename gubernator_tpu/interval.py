@@ -72,9 +72,40 @@ class IntervalLoop:
     def poke(self) -> None:
         self.interval.fire()
 
-    def close(self) -> None:
+    @staticmethod
+    def _drain_timeout_s() -> float:
+        """Per-loop drain bound: GUBER_DRAIN_GRACE when set (the
+        operator's whole-daemon drain budget — one wedged loop must
+        not eat more than it), else 5 s."""
+        import os
+
+        raw = os.environ.get("GUBER_DRAIN_GRACE", "")
+        if raw:
+            try:
+                from .config import parse_duration_ms
+
+                ms = parse_duration_ms(raw)
+                if ms > 0:
+                    return ms / 1000.0
+            except ValueError:
+                pass
+        return 5.0
+
+    def close(self, timeout_s: float | None = None) -> None:
         self.interval.stop()
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=self._drain_timeout_s()
+                          if timeout_s is None else timeout_s)
+        if self._thread.is_alive():
+            # a wedged fn() (dead-peer RPC with no deadline, device
+            # stall) must not hang shutdown — and running the final
+            # flush CONCURRENTLY with the wedged tick would race the
+            # very queues it flushes, so skip it and say so
+            import logging
+
+            logging.getLogger("gubernator_tpu").warning(
+                "interval loop %s did not drain within its bound; "
+                "skipping the final flush", self._thread.name)
+            return
         try:
             self._fn()  # final flush
         except Exception:  # pragma: no cover

@@ -192,7 +192,11 @@ static inline bool read_varint(const uint8_t** p, const uint8_t* end,
 //   None                                  (needs the pb2 fallback path)
 // | (n, khash_raw u64le, hits i64le, limit i64le, duration i64le,
 //    algorithm i32le, behavior i32le, burst i64le, behavior_or,
-//    tlv_off u64le, tlv_len u64le)
+//    tlv_off u64le, tlv_len u64le, created_at i64le)
+// created_at (field 10, 0 = unset) is the caller's accepted-at clock,
+// stamped by the forward hop (stamp_req_tlvs) so the owner applies the
+// request at the caller's time base — mixing bases resets buckets and
+// silently drops debits (the cold-key conservation loss).
 // tlv_off/tlv_len delimit each complete `requests` TLV (tag byte through
 // payload end) in the input: a clustered daemon forwards a sub-batch to
 // its owner by concatenating those slices verbatim — the peer wire's
@@ -205,7 +209,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
   const uint8_t* p = base;
   const uint8_t* end = p + view.len;
   std::vector<uint64_t> khash;
-  std::vector<int64_t> hits, limit, duration, burst;
+  std::vector<int64_t> hits, limit, duration, burst, created;
   std::vector<int32_t> alg, beh;
   std::vector<uint64_t> tlv_off, tlv_len;
   khash.reserve(64);
@@ -230,6 +234,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
     const uint8_t* key_p = nullptr;
     uint64_t name_len = 0, key_len = 0;
     int64_t f_hits = 0, f_limit = 0, f_dur = 0, f_burst = 0;
+    int64_t f_created = 0;
     int32_t f_alg = 0, f_beh = 0;
     while (q < qend && !fallback) {
       uint64_t t;
@@ -268,6 +273,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
           case 6: f_alg = (int32_t)v; break;
           case 7: f_beh = (int32_t)v; break;
           case 8: f_burst = (int64_t)v; break;
+          case 10: f_created = (int64_t)v; break;
           default: fallback = true;
         }
       } else {
@@ -294,6 +300,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
     limit.push_back(f_limit);
     duration.push_back(f_dur);
     burst.push_back(f_burst);
+    created.push_back(f_created);
     alg.push_back(f_alg);
     beh.push_back(f_beh);
     beh_or |= (uint64_t)(uint32_t)f_beh;
@@ -315,11 +322,89 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* arg) {
   const char* bu_p = n ? (const char*)burst.data() : kEmpty;
   const char* to_p = n ? (const char*)tlv_off.data() : kEmpty;
   const char* tl_p = n ? (const char*)tlv_len.data() : kEmpty;
+  const char* cr_p = n ? (const char*)created.data() : kEmpty;
   PyObject* out = Py_BuildValue(
-      "(ny#y#y#y#y#y#y#Ky#y#)", n, kh_p, n * 8, hi_p, n * 8, li_p, n * 8,
-      du_p, n * 8, al_p, n * 4, be_p, n * 4, bu_p, n * 8,
-      (unsigned long long)beh_or, to_p, n * 8, tl_p, n * 8);
+      "(ny#y#y#y#y#y#y#Ky#y#y#)", n, kh_p, n * 8, hi_p, n * 8, li_p,
+      n * 8, du_p, n * 8, al_p, n * 4, be_p, n * 4, bu_p, n * 8,
+      (unsigned long long)beh_or, to_p, n * 8, tl_p, n * 8, cr_p,
+      n * 8);
   return out;
+}
+
+// stamp_req_tlvs(data, tlv_off i64[], tlv_len i64[], created i64[],
+//                stamp_ms) -> bytes
+// The forward hop's bulk TLV join: concatenates the given request TLV
+// slices of `data`, appending `created_at = stamp_ms` (field 10) to
+// every slice whose parsed created_at is 0 — so a forwarded request
+// applies at the CALLER's clock on the owner (a slice that already
+// carries a caller stamp forwards verbatim: first hop wins).  The
+// arrays are pre-gathered by the caller (numpy fancy indexing), one
+// entry per forwarded row.
+static PyObject* stamp_req_tlvs(PyObject*, PyObject* args) {
+  Py_buffer view, boff, blen, bcreated;
+  long long stamp_ms;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*L", &view, &boff, &blen,
+                        &bcreated, &stamp_ms))
+    return nullptr;
+  Py_ssize_t n = boff.len / (Py_ssize_t)sizeof(int64_t);
+  const int64_t* toff = (const int64_t*)boff.buf;
+  const int64_t* tlen = (const int64_t*)blen.buf;
+  const int64_t* created = (const int64_t*)bcreated.buf;
+  const uint8_t* base = (const uint8_t*)view.buf;
+  bool bad = blen.len != boff.len || bcreated.len != boff.len;
+  // field-10 varint suffix: tag 0x50 + up to 10 payload bytes
+  uint8_t suffix[11];
+  Py_ssize_t suffix_len = 0;
+  suffix[suffix_len++] = 0x50;
+  uint64_t v = (uint64_t)stamp_ms;
+  while (v >= 0x80) {
+    suffix[suffix_len++] = (uint8_t)((v & 0x7F) | 0x80);
+    v >>= 7;
+  }
+  suffix[suffix_len++] = (uint8_t)v;
+  std::vector<uint8_t> out;
+  out.reserve((size_t)view.len + (size_t)n * (size_t)(suffix_len + 3));
+  for (Py_ssize_t i = 0; i < n && !bad; i++) {
+    const uint8_t* tlv = base + toff[i];
+    const uint8_t* tend = tlv + tlen[i];
+    if (toff[i] < 0 || tlen[i] < 2 || toff[i] + tlen[i] > view.len ||
+        tlv[0] != 0x0A) {
+      bad = true;
+      break;
+    }
+    if (created[i] != 0) {  // caller already stamped: verbatim
+      out.insert(out.end(), tlv, tend);
+      continue;
+    }
+    const uint8_t* p = tlv + 1;
+    uint64_t plen;
+    if (!read_varint(&p, tend, &plen) ||
+        (uint64_t)(tend - p) != plen) {
+      bad = true;
+      break;
+    }
+    uint64_t new_len = plen + (uint64_t)suffix_len;
+    out.push_back(0x0A);
+    uint64_t lv = new_len;
+    while (lv >= 0x80) {
+      out.push_back((uint8_t)((lv & 0x7F) | 0x80));
+      lv >>= 7;
+    }
+    out.push_back((uint8_t)lv);
+    out.insert(out.end(), p, tend);
+    out.insert(out.end(), suffix, suffix + suffix_len);
+  }
+  PyBuffer_Release(&view);
+  PyBuffer_Release(&boff);
+  PyBuffer_Release(&blen);
+  PyBuffer_Release(&bcreated);
+  if (bad) {
+    PyErr_SetString(PyExc_ValueError, "malformed request TLV slice");
+    return nullptr;
+  }
+  return PyBytes_FromStringAndSize(
+      out.empty() ? "" : (const char*)out.data(),
+      (Py_ssize_t)out.size());
 }
 
 // count_req_items(bytes) -> n | None
@@ -440,6 +525,7 @@ static PyObject* pack_wire_wave(PyObject*, PyObject* args) {
     const uint8_t* key_p = nullptr;
     uint64_t name_len = 0, key_len = 0;
     int64_t f_hits = 0, f_limit = 0, f_dur = 0, f_burst = 0;
+    int64_t f_created = 0;
     int32_t f_alg = 0, f_beh = 0;
     while (q < qend && !fallback) {
       uint64_t t;
@@ -478,6 +564,7 @@ static PyObject* pack_wire_wave(PyObject*, PyObject* args) {
           case 6: f_alg = (int32_t)v; break;
           case 7: f_beh = (int32_t)v; break;
           case 8: f_burst = (int64_t)v; break;
+          case 10: f_created = (int64_t)v; break;
           default: fallback = true;
         }
       } else {
@@ -527,7 +614,12 @@ static PyObject* pack_wire_wave(PyObject*, PyObject* args) {
     r_dur[n] = dur;
     r_eff[n] = eff;
     r_burst[n] = burst;
-    r_now[n] = (int64_t)now_ms;
+    // the caller's accepted-at clock wins when the forward hop stamped
+    // it (created_at, field 10): applying a forwarded request at OUR
+    // wall clock would mix time bases in the key's bucket row and a
+    // later base reads the earlier one as expired — bucket reset,
+    // debits silently gone (cold-key conservation loss)
+    r_now[n] = f_created > 0 ? f_created : (int64_t)now_ms;
     r_beh[n] = f_beh;
     r_alg[n] = leaky ? 1 : 0;
     r_valid[n] = 1;
@@ -768,6 +860,9 @@ static PyMethodDef methods[] = {
     {"pack_wire_wave", pack_wire_wave, METH_VARARGS,
      "Fused ingest: wire bytes -> clamped rows written into leased "
      "packed wave matrices (or None)"},
+    {"stamp_req_tlvs", stamp_req_tlvs, METH_VARARGS,
+     "Join request TLV slices, appending created_at (field 10) where "
+     "unset — the forward hop's caller-clock stamp"},
     {"split_resp_items", split_resp_items, METH_O,
      "RateLimitResp-list wire bytes -> per-item TLV ranges + status"},
     {"build_rate_limit_resps", build_rate_limit_resps, METH_VARARGS,

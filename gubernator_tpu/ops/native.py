@@ -32,7 +32,8 @@ def parse_get_rate_limits(data: bytes):
     r = _native.parse_get_rate_limits(data)
     if r is None:
         return None
-    n, kh, hits, limit, dur, alg, beh, burst, beh_or, toff, tlen = r
+    (n, kh, hits, limit, dur, alg, beh, burst, beh_or, toff, tlen,
+     created) = r
     return {
         "n": n,
         "khash_raw": np.frombuffer(kh, "<u8", count=n),
@@ -48,7 +49,26 @@ def parse_get_rate_limits(data: bytes):
         # wire framing is byte-compatible, field 1 on both messages)
         "tlv_off": np.frombuffer(toff, "<u8", count=n),
         "tlv_len": np.frombuffer(tlen, "<u8", count=n),
+        # caller's accepted-at clock (field 10, 0 = unset): forwarded
+        # rows apply at THIS time base, not the owner's wall clock
+        "created_at": np.frombuffer(created, "<i8", count=n),
     }
+
+
+def stamp_req_tlvs(data: bytes, tlv_off: np.ndarray, tlv_len: np.ndarray,
+                   created_at: np.ndarray, stamp_ms: int) -> bytes:
+    """Join the given request TLV slices of ``data``, appending
+    ``created_at = stamp_ms`` (field 10) to every slice that doesn't
+    already carry a caller stamp (created_at[i] == 0).  The forward
+    hop's bulk caller-clock stamp — see wire.tlv_with_created for the
+    one-slice codec-free twin and types.RateLimitRequest.created_at
+    for why the stamp exists."""
+    return _native.stamp_req_tlvs(
+        data,
+        np.ascontiguousarray(tlv_off, "<i8"),
+        np.ascontiguousarray(tlv_len, "<i8"),
+        np.ascontiguousarray(created_at, "<i8"),
+        int(stamp_ms))
 
 
 def count_req_items(data: bytes):

@@ -2,6 +2,17 @@
 
     python gubernator_tpu/ops/setup_native.py build_ext --inplace
     (or `make native` from the repo root)
+
+Sanitizer builds (never in place — the production .so stays untouched):
+
+    GUBER_NATIVE_SAN=tsan python gubernator_tpu/ops/setup_native.py \
+        build_ext --build-lib build/tsan
+    GUBER_NATIVE_SAN=asan ... --build-lib build/asan
+    (or `make tsan` / `make asan`, which also run the multithreaded
+    native soak under the instrumented .so — tools/native_soak.py)
+
+The sanitized objects land in their own build-temp dir so a tsan build
+never poisons the production object cache (and vice versa).
 """
 import os
 
@@ -9,14 +20,41 @@ from setuptools import Extension, setup
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
+SAN_FLAGS = {
+    "": [],
+    # -O1 -fno-omit-frame-pointer: the sanitizer runtimes want real
+    # stacks; -O3 inlining makes reports unreadable
+    "tsan": ["-fsanitize=thread", "-O1", "-g", "-fno-omit-frame-pointer"],
+    "asan": ["-fsanitize=address", "-O1", "-g",
+             "-fno-omit-frame-pointer"],
+}
+
+san = os.environ.get("GUBER_NATIVE_SAN", "")
+if san not in SAN_FLAGS:
+    raise SystemExit(
+        f"GUBER_NATIVE_SAN={san!r}: want 'tsan', 'asan', or unset")
+san_compile = SAN_FLAGS[san]
+san_link = [f for f in san_compile if f.startswith("-fsanitize")]
+
+script_args = None
+if san:
+    import sys
+
+    # sanitized builds must not share the default build-temp with the
+    # production build — same source, different instrumentation
+    if "--build-temp" not in " ".join(sys.argv):
+        sys.argv += ["--build-temp", os.path.join("build", f"tmp-{san}")]
+
 setup(
     name="gubernator-tpu-native",
-    script_args=None,
+    script_args=script_args,
     ext_modules=[
         Extension(
             "gubernator_tpu.ops._native",
             sources=[os.path.relpath(os.path.join(HERE, "_native.cpp"))],
-            extra_compile_args=["-O3", "-std=c++17"],
+            extra_compile_args=(["-std=c++17"]
+                                + (san_compile or ["-O3"])),
+            extra_link_args=san_link,
         )
     ],
 )

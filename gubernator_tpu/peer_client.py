@@ -100,12 +100,13 @@ class _SendLane:
         self.backoff_s = max(int(getattr(b, "peer_retry_backoff_ms", 25)),
                              0) / 1e3
         self._cond = threading.Condition()
-        self._buf = bytearray()  # pooled: entries append, flush cuts
-        self._entries: "deque[_Entry]" = deque()
-        self._queued_items = 0
-        self._inflight = 0
-        self._thread: Optional[threading.Thread] = None
-        self._closing = False
+        #: pooled: entries append, flush cuts
+        self._buf = bytearray()  # guarded-by: self._cond
+        self._entries: "deque[_Entry]" = deque()  # guarded-by: self._cond
+        self._queued_items = 0  # guarded-by: self._cond
+        self._inflight = 0  # guarded-by: self._cond
+        self._thread: Optional[threading.Thread] = None  # guarded-by: self._cond
+        self._closing = False  # guarded-by: self._cond
 
     # ---- producer side -------------------------------------------------
 
@@ -141,6 +142,7 @@ class _SendLane:
 
     # ---- flusher -------------------------------------------------------
 
+    # lock-free: caller holds self._cond (the flusher's take under its wait loop)
     def _take_locked(self) -> tuple:
         """Pop entries for one flush under _cond: greedy, never
         overshooting max_items — the entry that would overflow leads
@@ -171,6 +173,7 @@ class _SendLane:
                     return  # closing and drained
                 batch, data, items = self._take_locked()
             if (items < self.max_items and self.window_s > 0
+                    # lock-free: racy bool read; a late close just skips the straggler wait
                     and not self._closing):
                 # straggler window: only after the backlog was drained
                 # (a full flush skips the wait entirely)
@@ -212,6 +215,7 @@ class _SendLane:
     def _launch(self, entries: List[_Entry], data: bytes,
                 attempt: int) -> None:
         client = self.client
+        # lock-free: racy bool read; a retry racing close fails fast next hop
         if attempt and (self._closing or client._closing.is_set()):
             # a retry timer outliving shutdown must fail fast, never
             # re-dial a closed channel
@@ -275,6 +279,7 @@ class _SendLane:
             # the forward hop's share of a request's wall time
             client._analytics.observe_phase("peer_flush", dt)
         if err is not None:
+            # lock-free: racy bool read; a retry racing close fails fast next hop
             if (attempt < self.retries and not self._closing
                     and not client._circuit_blocked()):
                 if m is not None:
@@ -383,9 +388,10 @@ class PeerClient:
         #: optional FaultSet (faults.py): peer_send / peer_recv /
         #: peer_circuit faultpoints, tagged with this peer's address
         self._faults = faults
-        self._channel: Optional[grpc.Channel] = None
-        self._stub: Optional[PeersV1Stub] = None
-        self._raw_calls: dict = {}  # method → bytes-lane call handle
+        self._channel: Optional[grpc.Channel] = None  # guarded-by: self._lock
+        self._stub: Optional[PeersV1Stub] = None  # guarded-by: self._lock
+        #: method → bytes-lane call handle
+        self._raw_calls: dict = {}  # guarded-by: self._lock
         #: legacy object-batching queue (no-native fallback):
         #: (request, future, captured traceparent-or-None)
         self._queue: "queue.Queue[tuple]" = queue.Queue()
@@ -395,17 +401,17 @@ class PeerClient:
         # circuit breaker, shared by both lanes: consecutive final
         # flush failures open it; one success closes it
         self._circ_mu = threading.Lock()
-        self._consec_failures = 0
-        self._open_until = 0.0
-        self._circuit_opens = 0
+        self._consec_failures = 0  # guarded-by: self._circ_mu
+        self._open_until = 0.0  # guarded-by: self._circ_mu
+        self._circuit_opens = 0  # guarded-by: self._circ_mu
         # routing-health hysteresis (ISSUE 5, health-gated ring):
         # _route_bad_since = start of the current circuit-open streak
         # (0 while healthy); _route_recovered_at = when the last streak
         # ended; _route_ejected = this peer is currently out of the
         # routing ring and held out until the readmit window passes
-        self._route_bad_since = 0.0
-        self._route_recovered_at = 0.0
-        self._route_ejected = False
+        self._route_bad_since = 0.0  # guarded-by: self._circ_mu
+        self._route_recovered_at = 0.0  # guarded-by: self._circ_mu
+        self._route_ejected = False  # guarded-by: self._circ_mu
         fwd_timeout = behaviors.batch_timeout_ms / 1000.0 + 60.0
         upd_timeout = behaviors.global_timeout_ms / 1000.0
         if _wire_native is not None:
@@ -476,6 +482,7 @@ class PeerClient:
         if not was_open:
             log.warning("peer %s circuit OPEN after %d consecutive "
                         "flush failures; failing fast for %.1fs",
+                        # lock-free: diagnostic snapshot just off the lock
                         self.info.grpc_address, self._consec_failures,
                         cooldown)
             if self._metrics is not None:

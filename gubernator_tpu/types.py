@@ -123,6 +123,16 @@ class RateLimitRequest:
     algorithm: Algorithm | int = Algorithm.TOKEN_BUCKET
     behavior: Behavior | int = Behavior.BATCHING
     burst: int = 0  # 0 → defaults to limit (leaky bucket only)
+    #: Epoch-ms timestamp the request was ACCEPTED at (proto field 10;
+    #: 0 = unset → the serving daemon stamps its own clock).  The
+    #: forward hop sets it so a request applies at the CALLER's clock
+    #: wherever it lands: without it, a key served through two daemons
+    #: mixes two time bases in one bucket row, and the later base sees
+    #: the earlier-base row as expired — the bucket resets and every
+    #: prior debit is silently discarded (the concurrent cold-key
+    #: conservation loss; cross-daemon clock skew does the same to
+    #: short-duration limits in production).
+    created_at: int = 0
     metadata: Dict[str, str] = field(default_factory=dict)
 
     @property

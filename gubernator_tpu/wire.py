@@ -57,7 +57,62 @@ def req_from_tlv(tlv: bytes) -> RateLimitRequest:
         if not b & 0x80:
             break
         shift += 7
-    return req_from_pb(pb.RateLimitReq.FromString(tlv[i:i + ln]))
+    payload = tlv[i:i + ln]
+    req = req_from_pb(pb.RateLimitReq.FromString(payload))
+    # created_at (field 10) is wire-only until `make proto` regenerates
+    # the pb2 classes: pb2 parses it into the unknown-field set, so the
+    # hand scan below is what keeps the caller's clock attached to
+    # requests materialized from raw TLVs
+    created = tlv_created_at_payload(payload)
+    if created:
+        req.created_at = created
+    return req
+
+
+def tlv_created_at_payload(payload: bytes) -> int:
+    """Scan a RateLimitReq payload for ``created_at`` (field 10 varint;
+    proto3 last-value-wins).  Returns 0 when absent or on any framing
+    this scanner doesn't model (the caller treats 0 as unset)."""
+    i, n = 0, len(payload)
+    created = 0
+    while i < n:
+        tag, shift = 0, 0
+        while i < n:
+            b = payload[i]
+            tag |= (b & 0x7F) << shift
+            i += 1
+            if not b & 0x80:
+                break
+            shift += 7
+        field_no, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, shift = 0, 0
+            while i < n:
+                b = payload[i]
+                v |= (b & 0x7F) << shift
+                i += 1
+                if not b & 0x80:
+                    break
+                shift += 7
+            if field_no == 10:
+                created = v
+        elif wt == 2:
+            ln, shift = 0, 0
+            while i < n:
+                b = payload[i]
+                ln |= (b & 0x7F) << shift
+                i += 1
+                if not b & 0x80:
+                    break
+                shift += 7
+            i += ln
+        elif wt == 1:
+            i += 8
+        elif wt == 5:
+            i += 4
+        else:
+            return 0  # unmodeled wire type: treat as unset
+    return created
 
 
 def _varint(v: int) -> bytes:
@@ -73,8 +128,12 @@ def req_to_tlv(r: RateLimitRequest) -> bytes:
     """Request → one `requests` TLV slice (tag 0x0a + varint length +
     RateLimitReq payload) — the columnar peer send lanes' entry unit
     (GetRateLimitsReq.requests and GetPeerRateLimitsReq.requests share
-    field 1, so the slice is valid in either frame)."""
+    field 1, so the slice is valid in either frame).  ``created_at``
+    rides as a hand-appended field-10 varint until `make proto`
+    regenerates the pb2 classes with the field."""
     payload = req_to_pb(r).SerializeToString()
+    if r.created_at:
+        payload += b"\x50" + _varint(int(r.created_at))
     return b"\x0a" + _varint(len(payload)) + payload
 
 
@@ -94,6 +153,27 @@ def tlv_with_hits(tlv: bytes, hits: int) -> bytes:
             break
         shift += 7
     payload = tlv[i:i + ln] + b"\x18" + _varint(int(hits))
+    return b"\x0a" + _varint(len(payload)) + payload
+
+
+def tlv_with_created(tlv: bytes, created_ms: int) -> bytes:
+    """A request TLV slice with ``created_at`` (field 10) appended —
+    the forward hop stamps the CALLER's accepted-at clock onto each
+    raw slice it ships to the owner, so the owner applies the request
+    at the caller's time base instead of its own wall clock (see
+    types.RateLimitRequest.created_at for why mixing bases loses
+    debits).  Same rebuild-the-outer-length trick as tlv_with_hits;
+    the C++ lane does this in bulk (ops/_native.cpp › stamp_req_tlvs),
+    this is the codec-free twin."""
+    i, shift, ln = 1, 0, 0
+    while True:
+        b = tlv[i]
+        ln |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            break
+        shift += 7
+    payload = tlv[i:i + ln] + b"\x50" + _varint(int(created_ms))
     return b"\x0a" + _varint(len(payload)) + payload
 
 

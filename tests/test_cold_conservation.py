@@ -1,0 +1,151 @@
+"""Unwarmed concurrent cold-key conservation (the PR-5 ROADMAP debt).
+
+16 threads hammer a small set of BRAND-NEW keys with 1-row batches
+through daemons 0 AND 1 of a 3-daemon cluster — no pre-warm — and
+every hit must debit exactly once cluster-wide.  Pre-fix, forwarded
+rows applied at the owner's wall clock while locally-served rows
+applied at the caller's pinned ``now``: two time bases in one bucket
+row, and the later base read the earlier-base row as EXPIRED → bucket
+reset → 10-30% of the hits silently vanished per run (callers still
+got success responses).  Warming each key first masked the loss, which
+is why the PR-3 conservation test (which warms) never saw it.
+
+The fix forwards the caller's accepted-at clock (created_at, proto
+field 10) on the forward hop and the deferred hit queues; the
+``GUBER_CREATED_AT_FWD=0`` escape restores the pre-fix behavior so the
+loss stays demonstrable (tools/racer.py --no-created-at, and the
+sharpness test below).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.types import RateLimitRequest
+
+pytest.importorskip("gubernator_tpu.ops._native",
+                    reason="clustered wire lanes need the C++ codec")
+
+DAY = 24 * 3_600_000
+#: pinned far from the wall clock so any lane substituting its own
+#: clock for the caller's time base breaks conservation VISIBLY
+NOW0 = 1_750_000_000_000
+LIMIT = 10 ** 6
+N_THREADS, REPS, HITS, N_KEYS = 16, 4, 2, 10
+
+
+def serialize(reqs):
+    msg = pb.GetRateLimitsReq()
+    for r in reqs:
+        m = msg.requests.add()
+        m.name = r.name
+        m.unique_key = r.unique_key
+        m.hits = r.hits
+        m.limit = r.limit
+        m.duration = r.duration
+        m.algorithm = int(r.algorithm)
+        m.behavior = int(r.behavior)
+        m.burst = r.burst
+    return msg.SerializeToString()
+
+
+def one(hits, key, name):
+    return serialize([RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=LIMIT,
+        duration=DAY)])
+
+
+def _run_cold(c, tag: str, lane: str) -> tuple[int, int]:
+    """One unwarmed run over fresh keys; returns (sent, debited)."""
+    name = f"coldcons-{tag}"
+    keys = [f"coldcons-{tag}-{i}" for i in range(N_KEYS)]
+    # warm ENGINES with an unrelated key (compile cost must not
+    # serialize the schedule) — never the keys under test
+    for d in range(3):
+        c.instance_at(d).get_rate_limits_wire(
+            one(0, f"warmup-{tag}", name), now_ms=NOW0)
+    errs: list = []
+    barrier = threading.Barrier(N_THREADS)
+
+    def worker(t):
+        inst = c.instance_at(t % 2)  # both entry daemons
+        try:
+            barrier.wait(timeout=60)
+            for r in range(REPS):
+                key = keys[(t + r) % N_KEYS]
+                if lane == "wire":
+                    out = pb.GetRateLimitsResp.FromString(
+                        inst.get_rate_limits_wire(one(HITS, key, name),
+                                                  now_ms=NOW0 + 1 + r))
+                    err = out.responses[0].error
+                else:
+                    resp = inst.get_rate_limits(
+                        [RateLimitRequest(name=name, unique_key=key,
+                                          hits=HITS, limit=LIMIT,
+                                          duration=DAY)],
+                        now_ms=NOW0 + 1 + r)[0]
+                    err = resp.error
+                assert not err, err
+        except Exception as e:  # noqa: BLE001 - audited below
+            errs.append(repr(e))
+
+    ths = [threading.Thread(target=worker, args=(t,))
+           for t in range(N_THREADS)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=120)
+    assert not any(th.is_alive() for th in ths), "stuck caller"
+    assert not errs, errs[:3]
+    total = 0
+    for k in keys:
+        q = pb.GetRateLimitsResp.FromString(
+            c.instance_at(0).get_rate_limits_wire(one(0, k, name),
+                                                  now_ms=NOW0 + 1000))
+        assert q.responses[0].error == ""
+        total += LIMIT - int(q.responses[0].remaining)
+    return N_THREADS * REPS * HITS, total
+
+
+class TestUnwarmedColdKeyConservation:
+    def test_wire_lane_exact(self):
+        c = cluster_mod.start(3)
+        try:
+            for run in range(2):
+                sent, debited = _run_cold(c, f"w{run}", "wire")
+                assert debited == sent, \
+                    f"run {run}: cold-key conservation broken " \
+                    f"(wire lane): {debited} != {sent}"
+        finally:
+            c.stop()
+
+    def test_object_lane_exact(self):
+        c = cluster_mod.start(3)
+        try:
+            sent, debited = _run_cold(c, "obj", "object")
+            assert debited == sent, \
+                f"cold-key conservation broken (object lane): " \
+                f"{debited} != {sent}"
+        finally:
+            c.stop()
+
+    def test_prefix_behavior_still_loses(self, monkeypatch):
+        """Sharpness: with caller-clock forwarding disabled
+        (GUBER_CREATED_AT_FWD=0 — the pre-fix behavior) the same
+        schedule LOSES hits.  If this starts passing, the regression
+        tests above have stopped exercising the failure mode."""
+        monkeypatch.setenv("GUBER_CREATED_AT_FWD", "0")
+        c = cluster_mod.start(3)
+        try:
+            lost = 0
+            for run in range(2):
+                sent, debited = _run_cold(c, f"pre{run}", "wire")
+                assert debited <= sent
+                lost += sent - debited
+            assert lost > 0, \
+                "pre-fix behavior no longer reproduces the loss — " \
+                "the conservation tests above are no longer sharp"
+        finally:
+            c.stop()

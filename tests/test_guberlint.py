@@ -1,0 +1,272 @@
+"""guberlint pass semantics: fixture modules with KNOWN violations
+must produce exactly the expected diagnostics, and the blessed
+variants of the same code must produce none.
+
+tests/test_lint_clean.py pins the other half of the contract (the
+real tree is clean at HEAD); this file pins that the checker actually
+catches what it claims to catch — a lint that never fires is worse
+than no lint, because it certifies discipline nobody is keeping.
+"""
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.guberlint import Violation, run_passes
+
+
+def lint_fixture(tmp_path: Path, source: str, passes):
+    """Write ``source`` as a fixture module and run the given passes
+    over JUST it (plus the real tree's config/faults for registries)."""
+    mod = tmp_path / "fixture_mod.py"
+    mod.write_text(textwrap.dedent(source))
+    return [v for v in run_passes(passes=passes, extra_files=[mod])
+            if v.path.endswith("fixture_mod.py")]
+
+
+class TestGuardedPass:
+    BAD = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._n = 0  # guarded-by: self._mu
+
+            def bump(self):
+                with self._mu:
+                    self._n += 1
+
+            def peek(self):
+                return self._n
+    """
+
+    def test_unlocked_access_is_flagged_exactly(self, tmp_path):
+        vs = lint_fixture(tmp_path, self.BAD, ["guarded"])
+        assert len(vs) == 1
+        v = vs[0]
+        assert v.pass_id == "guarded"
+        assert v.line == 14
+        assert "Counter._n" in v.message
+        assert "with self._mu" in v.message
+
+    def test_lock_free_annotation_clears_it(self, tmp_path):
+        ok = self.BAD.replace(
+            "return self._n",
+            "return self._n  # lock-free: GIL-atomic int read")
+        assert lint_fixture(tmp_path, ok, ["guarded"]) == []
+
+    def test_def_level_annotation_blesses_function(self, tmp_path):
+        ok = self.BAD.replace(
+            "def peek(self):",
+            "def peek(self):  # lock-free: snapshot, staleness ok")
+        assert lint_fixture(tmp_path, ok, ["guarded"]) == []
+
+    def test_with_lock_access_is_clean(self, tmp_path):
+        ok = self.BAD.replace(
+            "return self._n",
+            "with self._mu:\n            return self._n")
+        assert lint_fixture(tmp_path, ok, ["guarded"]) == []
+
+    def test_init_is_exempt(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._n = 0  # guarded-by: self._mu
+                    self._n = self._n + 1  # construction: no lock yet
+        """
+        assert lint_fixture(tmp_path, src, ["guarded"]) == []
+
+    def test_conflicting_declarations_flagged(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._other = threading.Lock()
+                    self._n = 0  # guarded-by: self._mu
+
+                def reset(self):
+                    with self._other:
+                        self._n = 0  # guarded-by: self._other
+        """
+        vs = lint_fixture(tmp_path, src, ["guarded"])
+        assert any("one attribute, one lock" in v.message for v in vs)
+
+
+class TestLockOrderPass:
+    def test_inverted_nesting_flagged(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._tel_mu = threading.Lock()
+                    self._submit_mu = threading.Lock()
+
+                def bad(self):
+                    with self._tel_mu:
+                        with self._submit_mu:
+                            pass
+        """
+        vs = lint_fixture(tmp_path, src, ["lockorder"])
+        assert len(vs) == 1
+        assert vs[0].line == 11
+        assert "submit_mu" in vs[0].message
+        assert "tel_mu" in vs[0].message
+
+    def test_correct_nesting_clean(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._tel_mu = threading.Lock()
+                    self._submit_mu = threading.Lock()
+
+                def good(self):
+                    with self._submit_mu:
+                        with self._tel_mu:
+                            pass
+        """
+        assert lint_fixture(tmp_path, src, ["lockorder"]) == []
+
+    def test_same_lock_twice_flagged(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._tel_mu = threading.Lock()
+
+                def deadlock(self):
+                    with self._tel_mu:
+                        with self._tel_mu:
+                            pass
+        """
+        vs = lint_fixture(tmp_path, src, ["lockorder"])
+        assert len(vs) == 1
+
+    def test_nested_function_resets_held_set(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._tel_mu = threading.Lock()
+                    self._submit_mu = threading.Lock()
+
+                def ok(self):
+                    with self._tel_mu:
+                        def callback():
+                            with self._submit_mu:
+                                pass
+                        return callback
+        """
+        assert lint_fixture(tmp_path, src, ["lockorder"]) == []
+
+
+class TestEnvRegPass:
+    def test_unregistered_var_flagged(self, tmp_path):
+        src = """
+            import os
+
+            KNOB = os.environ.get("GUBER_DEFINITELY_NOT_REGISTERED", "")
+        """
+        vs = lint_fixture(tmp_path, src, ["envreg"])
+        assert len(vs) == 1
+        assert "GUBER_DEFINITELY_NOT_REGISTERED" in vs[0].message
+        assert "ENV_REGISTRY" in vs[0].message
+
+    def test_registered_var_clean(self, tmp_path):
+        src = """
+            import os
+
+            KNOB = os.environ.get("GUBER_COALESCE_US", "")
+        """
+        assert lint_fixture(tmp_path, src, ["envreg"]) == []
+
+    def test_subscript_and_in_shapes_detected(self, tmp_path):
+        src = """
+            import os
+
+            A = os.environ["GUBER_NOT_IN_REGISTRY_A"]
+            B = "GUBER_NOT_IN_REGISTRY_B" in os.environ
+        """
+        vs = lint_fixture(tmp_path, src, ["envreg"])
+        assert {m for v in vs for m in v.message.split()
+                if m.startswith("GUBER_NOT")} == {
+            "GUBER_NOT_IN_REGISTRY_A", "GUBER_NOT_IN_REGISTRY_B"}
+
+
+class TestFaultCatPass:
+    def test_unknown_point_flagged(self, tmp_path):
+        src = """
+            class C:
+                def go(self):
+                    self._fault("definitely_not_a_faultpoint")
+        """
+        vs = lint_fixture(tmp_path, src, ["faultcat"])
+        assert len(vs) == 1
+        assert "definitely_not_a_faultpoint" in vs[0].message
+
+    def test_cataloged_point_clean(self, tmp_path):
+        src = """
+            class C:
+                def go(self):
+                    self._fault("device_step")
+        """
+        assert lint_fixture(tmp_path, src, ["faultcat"]) == []
+
+
+class TestThreadsPass:
+    def test_anonymous_thread_flagged(self, tmp_path):
+        src = """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                return t
+        """
+        vs = lint_fixture(tmp_path, src, ["threads"])
+        assert len(vs) == 1
+        assert "name=" in vs[0].message
+
+    def test_unbounded_join_flagged(self, tmp_path):
+        src = """
+            import threading
+
+            def drain(t):
+                t.join()
+        """
+        vs = lint_fixture(tmp_path, src, ["threads"])
+        assert len(vs) == 1
+        assert "timeout" in vs[0].message
+        assert "GUBER_DRAIN_GRACE" in vs[0].message
+
+    def test_named_thread_and_bounded_join_clean(self, tmp_path):
+        src = """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn, daemon=True, name="w")
+                t.start()
+                t.join(timeout=5)
+                return t
+        """
+        assert lint_fixture(tmp_path, src, ["threads"]) == []
+
+
+class TestCliAndApi:
+    def test_violation_render_format(self):
+        v = Violation("a/b.py", 7, "guarded", "boom")
+        assert v.render() == "a/b.py:7: [guarded] boom"
+
+    def test_unknown_pass_is_loud(self):
+        with pytest.raises(ValueError, match="unknown guberlint pass"):
+            run_passes(passes=["nope"])

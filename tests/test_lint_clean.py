@@ -1,0 +1,26 @@
+"""Tier-1 invariant: guberlint reports ZERO violations at HEAD.
+
+This is the enforcement half of the concurrency-discipline tooling
+(tools/guberlint/, CONCURRENCY.md): the checker's semantics are pinned
+by tests/test_guberlint.py; this test pins that the tree actually
+SATISFIES them — every guarded-by annotation holds, the lock hierarchy
+is respected, the GUBER_* registry and faultpoint catalog match the
+code, every thread is named and every join bounded.  A red run here
+points at the exact file:line to fix (or to annotate, with a reason).
+"""
+from tools.guberlint import PASS_NAMES, run_passes
+
+
+def test_tree_is_lint_clean_at_head():
+    violations = run_passes()
+    assert not violations, \
+        "guberlint violations at HEAD:\n" + "\n".join(
+            v.render() for v in violations)
+
+
+def test_all_passes_ran():
+    # run_passes with no filter must cover the full suite — a pass
+    # silently dropped from PASS_NAMES would turn the invariant above
+    # into a partial check
+    assert set(PASS_NAMES) == {"guarded", "lockorder", "envreg",
+                               "faultcat", "threads"}

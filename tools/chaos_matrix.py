@@ -232,6 +232,12 @@ MATRIX = {
     "dispatch_enqueue": (_drive_dispatch, "cluster"),
     "dispatch_launch": (_drive_dispatch, "cluster"),
     "dispatch_sync": (_drive_dispatch, "cluster"),
+    # the racer's preemption points (ISSUE 6): exercised by the same
+    # dispatch driver — error fails the wave's callers, delay widens
+    # the merge/carry/splice windows (tools/racer.py leans on these)
+    "dispatch_merge": (_drive_dispatch, "cluster"),
+    "dispatch_carry": (_drive_dispatch, "cluster"),
+    "dispatch_splice": (_drive_dispatch, "cluster"),
     "device_step": (_drive_dispatch, "cluster"),
     "wire_ingest": (_drive_ingest, "cluster"),
     "global_broadcast": (_drive_global("_bcast_loop"), "cluster"),

@@ -13,7 +13,13 @@ Asserts, against a fresh ``Metrics()`` registry:
    (literal first arguments to ``.record(...)`` / ``.record_error(...)``
    / ``._record_event(...)`` anywhere under gubernator_tpu/) appears in
    OBSERVABILITY.md's event table, and vice versa — an undocumented
-   event kind is invisible to whoever greps the doc mid-incident.
+   event kind is invisible to whoever greps the doc mid-incident;
+5. RESILIENCE.md's faultpoint table matches faults.FAULT_POINTS both
+   ways (guberlint's ``faultcat`` pass pins catalog ↔ code; this pins
+   catalog ↔ doc — together the chaos surface can't drift anywhere);
+6. CONCURRENCY.md's GUBER_* table matches config.ENV_REGISTRY both
+   ways (guberlint's ``envreg`` pass pins registry ↔ code), and its
+   lock-hierarchy table names every lock in guberlint's LOCK_ORDER.
 
 Exit 0 when clean; prints each violation and exits 1 otherwise.
 """
@@ -27,6 +33,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DOC = os.path.join(REPO, "OBSERVABILITY.md")
+RESILIENCE_DOC = os.path.join(REPO, "RESILIENCE.md")
+CONCURRENCY_DOC = os.path.join(REPO, "CONCURRENCY.md")
 
 #: sample suffixes prometheus_client appends — doc names are family
 #: names, but a doc mentioning the exposition form shouldn't fail lint
@@ -82,6 +90,79 @@ def documented_event_kinds(doc: str) -> set:
     return kinds
 
 
+def _table_cell_names(doc: str, heading: str, rx: str) -> set:
+    """Backticked names matching ``rx`` in the first column of the
+    table under ``heading`` (up to the next heading of any level)."""
+    try:
+        section = doc.split(heading, 1)[1]
+    except IndexError:
+        return set()
+    section = re.split(r"\n#{1,6} ", section, 1)[0]
+    names = set()
+    for line in section.splitlines():
+        if not line.startswith("| `"):
+            continue
+        first_cell = line.split("|")[1]
+        names.update(re.findall(rx, first_cell))
+    return names
+
+
+def faultpoint_doc_problems() -> list:
+    """RESILIENCE.md's faultpoint catalog table ↔ faults.FAULT_POINTS."""
+    from gubernator_tpu.faults import FAULT_POINTS
+
+    with open(RESILIENCE_DOC, encoding="utf-8") as f:
+        doc = f.read()
+    documented = _table_cell_names(doc, "### Faultpoint catalog",
+                                   r"`([a-z0-9_]+)`")
+    problems = []
+    for point in sorted(set(FAULT_POINTS) - documented):
+        problems.append(
+            f"faultpoint {point!r} is in faults.FAULT_POINTS but "
+            f"missing from RESILIENCE.md's catalog table")
+    for point in sorted(documented - set(FAULT_POINTS)):
+        problems.append(
+            f"RESILIENCE.md's catalog table documents faultpoint "
+            f"{point!r} but faults.FAULT_POINTS has no such point")
+    return problems
+
+
+def env_registry_doc_problems() -> list:
+    """CONCURRENCY.md's GUBER_* table ↔ config.ENV_REGISTRY, plus its
+    lock-hierarchy table ↔ guberlint's LOCK_ORDER."""
+    from gubernator_tpu.config import ENV_REGISTRY
+    from tools.guberlint.lockorder import LOCK_ORDER
+
+    problems = []
+    if not os.path.exists(CONCURRENCY_DOC):
+        return [f"{CONCURRENCY_DOC} is missing — the concurrency "
+                f"tooling's operator doc"]
+    with open(CONCURRENCY_DOC, encoding="utf-8") as f:
+        doc = f.read()
+    documented = _table_cell_names(doc, "## GUBER_* environment",
+                                   r"`(GUBER_[A-Z0-9_]+)`")
+    for var in sorted(set(ENV_REGISTRY) - documented):
+        problems.append(
+            f"env var {var} is in config.ENV_REGISTRY but missing from "
+            f"CONCURRENCY.md's GUBER_* table")
+    for var in sorted(documented - set(ENV_REGISTRY)):
+        problems.append(
+            f"CONCURRENCY.md's GUBER_* table documents {var} but "
+            f"config.ENV_REGISTRY has no such entry")
+    doc_locks = _table_cell_names(doc, "## Lock hierarchy",
+                                  r"`([a-z_]+)`")
+    for name, _pat in LOCK_ORDER:
+        if name not in doc_locks:
+            problems.append(
+                f"lock {name!r} is in guberlint LOCK_ORDER but missing "
+                f"from CONCURRENCY.md's lock-hierarchy table")
+    for name in sorted(doc_locks - {n for n, _ in LOCK_ORDER}):
+        problems.append(
+            f"CONCURRENCY.md's lock-hierarchy table documents lock "
+            f"{name!r} but guberlint LOCK_ORDER has no such rank")
+    return problems
+
+
 def main() -> int:
     from gubernator_tpu.metrics import Metrics
 
@@ -119,6 +200,9 @@ def main() -> int:
         problems.append(
             f"OBSERVABILITY.md's event table documents kind {kind!r} "
             f"but nothing emits it (stale doc entry)")
+
+    problems += faultpoint_doc_problems()
+    problems += env_registry_doc_problems()
 
     if problems:
         for p in problems:

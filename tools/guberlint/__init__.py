@@ -1,0 +1,89 @@
+"""guberlint — concurrency-discipline static analysis for gubernator-tpu.
+
+The host serving path is a deeply threaded system (depth-K dispatcher
+ring, pooled ingest buffers, per-peer send lanes resolving futures on
+gRPC callback threads, analytics drain workers, interval loops).  The
+reference leans on Go's race detector and lock conventions; this
+package is the repo's equivalent: AST-based passes that make the lock
+discipline *checkable*, run in tier-1 (tests/test_lint_clean.py) and
+by `make lint`.
+
+Passes (each one module in this package):
+
+- ``guarded``   — guarded-by checker: shared mutable attributes are
+  annotated ``# guarded-by: self._mu`` at their declaring assignment;
+  every other read/write site must be lexically inside
+  ``with self._mu`` (or carry ``# lock-free: <reason>``).
+- ``lockorder`` — the declared lock hierarchy (LOCK_ORDER, documented
+  in CONCURRENCY.md) admits no lexically nested acquisition against
+  the order.
+- ``envreg``    — every ``GUBER_*`` env read must appear in
+  config.ENV_REGISTRY (and every registry entry must be read
+  somewhere): the operator surface can't drift silently.
+- ``faultcat``  — every instrumented faultpoint name must exist in
+  faults.FAULT_POINTS and every cataloged point must have a site.
+- ``threads``   — every Thread(...) names itself (``name=``) and no
+  ``.join()`` runs unbounded (a dead worker must never hang drain
+  forever — joins carry a timeout).
+
+Annotation grammar (full spec in CONCURRENCY.md):
+
+    self._inflight = {}          # guarded-by: self._tel_mu
+    depth = self._queued_rows    # lock-free: GIL-atomic int read
+    def stats(self):             # lock-free: snapshot, staleness ok
+
+A ``# lock-free:`` on a ``def`` line blesses the whole function body.
+Declaring assignments and the whole constructor (``__init__``) are
+exempt — construction happens-before publication.
+"""
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One diagnostic: ``path:line: [pass_id] message``."""
+
+    path: str
+    line: int
+    pass_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+#: pass registry, populated lazily (each pass module exposes
+#: ``run(ctx) -> List[Violation]``)
+PASS_NAMES = ("guarded", "lockorder", "envreg", "faultcat", "threads")
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def run_passes(root: Optional[Path] = None,
+               passes: Optional[Iterable[str]] = None,
+               extra_files: Optional[List[Path]] = None
+               ) -> List[Violation]:
+    """Run the requested passes (default: all) over the repo rooted at
+    ``root``; returns violations sorted by (path, line).  ``extra_files``
+    adds out-of-tree sources (the fixture tests use this)."""
+    import importlib
+
+    from .engine import LintContext
+
+    root = root if root is not None else repo_root()
+    ctx = LintContext(root, extra_files=extra_files)
+    out: List[Violation] = []
+    for name in (passes if passes is not None else PASS_NAMES):
+        if name not in PASS_NAMES:
+            raise ValueError(
+                f"unknown guberlint pass {name!r} (have: "
+                f"{', '.join(PASS_NAMES)})")
+        mod = importlib.import_module(f".{name}", __package__)
+        out.extend(mod.run(ctx))
+    return sorted(out, key=lambda v: (v.path, v.line, v.pass_id))

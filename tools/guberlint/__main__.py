@@ -1,0 +1,39 @@
+"""CLI: ``python -m tools.guberlint`` (what ``make lint`` runs).
+
+Exit 0 on a clean tree, 1 with one ``path:line: [pass] message`` line
+per violation.  ``--pass`` restricts to one pass; ``--json`` emits the
+violations as a JSON list (bench provenance uses this).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import PASS_NAMES, run_passes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="guberlint",
+        description="concurrency-discipline lint (see CONCURRENCY.md)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASS_NAMES,
+                    help="run only this pass (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit violations as JSON")
+    args = ap.parse_args(argv)
+    violations = run_passes(passes=args.passes)
+    if args.json:
+        print(json.dumps([v.__dict__ for v in violations], indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+        n = len(violations)
+        print(f"guberlint: {n} violation{'s' if n != 1 else ''}"
+              + ("" if n else " — tree is clean"))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

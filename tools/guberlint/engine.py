@@ -1,0 +1,114 @@
+"""Shared lint machinery: file discovery, parsed sources, comments.
+
+Every pass consumes a :class:`LintContext`: lazily-parsed ASTs plus a
+per-line comment map (pulled with ``tokenize`` so annotations survive
+exactly as written).  Paths are repo-relative in diagnostics.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import tokenize
+from pathlib import Path
+from typing import Dict, List, Optional
+
+
+class SourceFile:
+    """One parsed source: tree + raw lines + per-line comments."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        text = path.read_text()
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)
+        #: line no (1-based) → comment text without the leading '#'
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = \
+                        tok.string.lstrip("#").strip()
+        except tokenize.TokenError:  # pragma: no cover - parse caught it
+            pass
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def annotation(self, line: int, key: str) -> Optional[str]:
+        """Return the value of a ``# <key>: value`` annotation on
+        ``line`` (or None).  Keys: guarded-by, lock-free."""
+        c = self.comments.get(line, "")
+        if c.startswith(key + ":"):
+            return c[len(key) + 1:].strip()
+        return None
+
+
+#: packages whose sources the concurrency passes analyze (annotations
+#: and faultpoints live here; tools/tests are out of scope for them)
+CORE_PKG = "gubernator_tpu"
+
+#: files the env-registry pass additionally scans for GUBER_* reads
+ENV_EXTRA = ("bench.py",)
+
+
+class LintContext:
+    def __init__(self, root: Path,
+                 extra_files: Optional[List[Path]] = None):
+        self.root = root
+        self._cache: Dict[str, SourceFile] = {}
+        self.extra_files = [Path(p) for p in (extra_files or [])]
+
+    def _load(self, path: Path) -> Optional[SourceFile]:
+        rel = str(path.relative_to(self.root)) \
+            if path.is_relative_to(self.root) else str(path)
+        if rel not in self._cache:
+            try:
+                self._cache[rel] = SourceFile(path, rel)
+            except (SyntaxError, UnicodeDecodeError, OSError):
+                return None  # non-source or unparseable: not lintable
+        return self._cache[rel]
+
+    def _walk(self, base: Path) -> List[Path]:
+        return sorted(
+            p for p in base.rglob("*.py")
+            if "__pycache__" not in p.parts and "_pb2" not in p.name)
+
+    def core_files(self) -> List[SourceFile]:
+        """gubernator_tpu/** sources (+ the fixtures' extra files)."""
+        out = []
+        for p in self._walk(self.root / CORE_PKG) + self.extra_files:
+            sf = self._load(p)
+            if sf is not None:
+                out.append(sf)
+        return out
+
+    def env_scan_files(self) -> List[SourceFile]:
+        """Everything that may read GUBER_* env vars: the core package,
+        tools/ (guberlint itself excluded), and bench.py."""
+        paths = self._walk(self.root / CORE_PKG)
+        paths += [p for p in self._walk(self.root / "tools")
+                  if "guberlint" not in p.parts]
+        paths += [self.root / f for f in ENV_EXTRA
+                  if (self.root / f).exists()]
+        out = []
+        for p in paths + self.extra_files:
+            sf = self._load(p)
+            if sf is not None:
+                out.append(sf)
+        return out
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return "<unparseable>"
+
+
+def func_defs(tree: ast.AST):
+    """Yield every (Async)FunctionDef in the tree."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

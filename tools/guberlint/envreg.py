@@ -1,0 +1,105 @@
+"""envreg — the GUBER_* environment-variable registry check.
+
+Every ``GUBER_*`` read in the code (``os.environ.get``, ``os.getenv``,
+``environ[...]``, ``"X" in os.environ``, config's ``src.get``) must be
+declared in ``config.ENV_REGISTRY`` with a one-line description, and
+every declared variable must still be read somewhere — so the operator
+surface (docs, example.conf, runbooks) can never drift from the code.
+tools/check_metrics.py lints the prose docs against the same registry.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from . import Violation
+from .engine import LintContext, unparse
+
+PASS_ID = "envreg"
+
+_GUBER = re.compile(r"^GUBER_[A-Z0-9_]+$")
+
+
+def _str_const(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def _env_reads(sf) -> List[Tuple[str, int]]:
+    """(var, line) for every GUBER_* env read shape in the file."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        var = ""
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name in ("get", "getenv", "_env_int") and node.args:
+                var = _str_const(node.args[0])
+        elif isinstance(node, ast.Subscript):
+            if unparse(node.value).endswith("environ"):
+                var = _str_const(node.slice)
+        elif isinstance(node, ast.Compare):
+            if (len(node.ops) == 1 and isinstance(node.ops[0], ast.In)
+                    and unparse(node.comparators[0]).endswith("environ")):
+                var = _str_const(node.left)
+        if var and _GUBER.match(var):
+            out.append((var, node.lineno))
+    return out
+
+
+def _registry(ctx: LintContext):
+    """(entries: var → line, registry_line) from config.ENV_REGISTRY."""
+    sf = None
+    for f in ctx.core_files():
+        if f.rel.endswith("config.py"):
+            sf = f
+            break
+    if sf is None:
+        return None, None, None
+    for node in ast.walk(sf.tree):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target]
+                   if isinstance(node, ast.AnnAssign) else [])
+        if any(isinstance(t, ast.Name) and t.id == "ENV_REGISTRY"
+               for t in targets):
+            if isinstance(node.value, ast.Dict):
+                entries: Dict[str, int] = {}
+                for k in node.value.keys:
+                    v = _str_const(k)
+                    if v:
+                        entries[v] = k.lineno
+                return sf, entries, node.lineno
+    return sf, None, None
+
+
+def run(ctx: LintContext) -> List[Violation]:
+    out: List[Violation] = []
+    cfg_sf, entries, reg_line = _registry(ctx)
+    if cfg_sf is None:
+        return out  # fixture trees without config.py: nothing to check
+    if entries is None:
+        out.append(Violation(
+            cfg_sf.rel, 1, PASS_ID,
+            "config.py has no ENV_REGISTRY dict literal — every "
+            "GUBER_* env var must be declared there"))
+        return out
+    seen: Dict[str, Tuple[str, int]] = {}
+    for sf in ctx.env_scan_files():
+        for var, line in _env_reads(sf):
+            seen.setdefault(var, (sf.rel, line))
+            if var not in entries:
+                out.append(Violation(
+                    sf.rel, line, PASS_ID,
+                    f"env var {var} read here but not declared in "
+                    f"config.ENV_REGISTRY — register it with a "
+                    f"one-line description"))
+    for var, line in entries.items():
+        if var not in seen:
+            out.append(Violation(
+                cfg_sf.rel, line, PASS_ID,
+                f"ENV_REGISTRY declares {var} but nothing reads it — "
+                f"remove the entry or the dead knob it describes"))
+    return out

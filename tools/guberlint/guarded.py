@@ -1,0 +1,175 @@
+"""guarded — the guarded-by checker.
+
+A shared mutable attribute declares its lock at the assignment that
+creates it::
+
+    self._inflight = {}  # guarded-by: self._tel_mu
+
+Every OTHER read/write of ``self._inflight`` inside the class must
+then be lexically inside ``with self._tel_mu:`` — or carry an explicit
+escape, either on the accessing statement or on the enclosing ``def``
+line::
+
+    depth = self._queued_rows  # lock-free: GIL-atomic int read
+    def debug_stats(self):  # lock-free: monotonic snapshot, stale ok
+
+Exemptions that need no annotation: the declaring assignment itself
+and the whole constructor (``__init__`` runs happens-before
+publication).  The analysis is LEXICAL: a helper that assumes its
+caller holds the lock must say so with ``# lock-free: caller holds
+<lock>`` — that sentence is exactly the convention the checker exists
+to make visible.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from . import Violation
+from .engine import LintContext, SourceFile, unparse
+
+PASS_ID = "guarded"
+
+
+def _norm(text: str) -> str:
+    return text.replace(" ", "")
+
+
+class _ClassAuditor(ast.NodeVisitor):
+    """Walks ONE class body enforcing its guarded-by declarations."""
+
+    def __init__(self, sf: SourceFile, cls: ast.ClassDef,
+                 declared: Dict[str, Tuple[str, int]],
+                 out: List[Violation]):
+        self.sf = sf
+        self.cls = cls
+        self.declared = declared
+        self.out = out
+        self._locks: List[str] = []   # normalized held-lock texts
+        self._stmt: List[ast.stmt] = []  # enclosing statement stack
+        self._fn: List[ast.FunctionDef] = []
+
+    # -- structure ----------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if node is self.cls:
+            self.generic_visit(node)
+        # nested classes audit separately (their own declarations)
+
+    def _visit_fn(self, node) -> None:
+        if node.name == "__init__" and len(self._fn) == 0:
+            return  # constructor: happens-before publication
+        if any(self.sf.annotation(ln, "lock-free") is not None
+               for ln in (node.lineno - 1, node.lineno)):
+            return  # whole function blessed (def line or just above)
+        self._fn.append(node)
+        for stmt in node.body:
+            self._visit_stmt(stmt)
+        self._fn.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_With(self, node: ast.With) -> None:
+        texts = [_norm(unparse(item.context_expr))
+                 for item in node.items]
+        self._locks.extend(texts)
+        for stmt in node.body:
+            self._visit_stmt(stmt)
+        del self._locks[len(self._locks) - len(texts):]
+        # context expressions themselves may read guarded state
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    visit_AsyncWith = visit_With
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        self._stmt.append(stmt)
+        self.visit(stmt)
+        self._stmt.pop()
+
+    def generic_visit(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._visit_stmt(child)
+            else:
+                self.visit(child)
+
+    # -- the check ----------------------------------------------------
+
+    def _stmt_annotated(self) -> bool:
+        if not self._stmt:
+            return False
+        stmt = self._stmt[-1]
+        end = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+        # the annotation may ride any line of the statement, or a
+        # comment line immediately above it (79-col reality)
+        return any(
+            self.sf.annotation(ln, "lock-free") is not None
+            for ln in range(stmt.lineno - 1, end + 1))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in self.declared):
+            return
+        lock, decl_line = self.declared[node.attr]
+        if node.lineno == decl_line:
+            return  # the declaring assignment
+        if _norm(lock) in self._locks:
+            return
+        if self.sf.annotation(node.lineno, "lock-free") is not None:
+            return
+        if self._stmt_annotated():
+            return
+        self.out.append(Violation(
+            self.sf.rel, node.lineno, PASS_ID,
+            f"{self.cls.name}.{node.attr} accessed outside "
+            f"'with {lock}' (declared guarded-by at line {decl_line}); "
+            f"hold the lock or annotate '# lock-free: <reason>'"))
+
+
+def _collect_declarations(sf: SourceFile, cls: ast.ClassDef,
+                          out: List[Violation]
+                          ) -> Dict[str, Tuple[str, int]]:
+    declared: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.ClassDef) and node is not cls:
+            continue
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            lock = sf.annotation(node.lineno, "guarded-by")
+            if lock is None:
+                continue
+            prev = declared.get(t.attr)
+            if prev is not None and _norm(prev[0]) != _norm(lock):
+                out.append(Violation(
+                    sf.rel, node.lineno, PASS_ID,
+                    f"{cls.name}.{t.attr} re-declared guarded-by "
+                    f"{lock!r} but line {prev[1]} says {prev[0]!r} — "
+                    f"one attribute, one lock"))
+                continue
+            if prev is None:
+                declared[t.attr] = (lock, node.lineno)
+    return declared
+
+
+def run(ctx: LintContext) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in ctx.core_files():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            declared = _collect_declarations(sf, node, out)
+            if declared:
+                _ClassAuditor(sf, node, declared, out).visit(node)
+    return out

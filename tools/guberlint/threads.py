@@ -1,0 +1,73 @@
+"""threads — thread-spawn inventory and bounded-drain discipline.
+
+Two rules over the core package:
+
+1. Every ``threading.Thread(...)`` construction passes ``name=``: an
+   anonymous ``Thread-7`` in a stack dump or the stall watchdog's
+   output is undebuggable, and CONCURRENCY.md's thread inventory is
+   keyed by these names (tools/check_metrics.py cross-checks).
+2. No ``.join()`` without a timeout: an unbounded join turns one
+   wedged worker into a hung drain — shutdown must bound every join
+   (GUBER_DRAIN_GRACE is the budget; the IntervalLoop hang this rule
+   was written against is pinned in tests/test_interval.py).
+
+``collect_thread_names`` exposes the inventory (module, name-expr)
+pairs for the CONCURRENCY.md doc check.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from . import Violation
+from .engine import LintContext, unparse
+
+PASS_ID = "threads"
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "Thread"
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "Thread"
+    return False
+
+
+def collect_thread_names(ctx: LintContext) -> List[Tuple[str, str]]:
+    """(module, name expression text) for every Thread construction —
+    the raw material of CONCURRENCY.md's thread inventory."""
+    out = []
+    for sf in ctx.core_files():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                name = next((kw.value for kw in node.keywords
+                             if kw.arg == "name"), None)
+                out.append((sf.rel,
+                            unparse(name) if name is not None else ""))
+    return out
+
+
+def run(ctx: LintContext) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in ctx.core_files():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_thread_ctor(node):
+                if not any(kw.arg == "name" for kw in node.keywords):
+                    out.append(Violation(
+                        sf.rel, node.lineno, PASS_ID,
+                        "Thread(...) without name= — name every "
+                        "thread (stack dumps, watchdog output, and "
+                        "the CONCURRENCY.md inventory key on it)"))
+                continue
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == "join"
+                    and not node.args and not node.keywords):
+                out.append(Violation(
+                    sf.rel, node.lineno, PASS_ID,
+                    f"unbounded {unparse(fn)}() — a wedged worker "
+                    f"hangs this join forever; pass a timeout "
+                    f"(drain budget: GUBER_DRAIN_GRACE)"))
+    return out

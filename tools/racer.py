@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Deterministic interleaving harness: seeded adversarial preemptions
+between serving lanes, with exact conservation as the oracle.
+
+The dispatcher's merge/carry/splice faultpoints (faults.py ›
+dispatch_merge / dispatch_carry / dispatch_splice, delay mode) are the
+preemption points: arming them with seed-derived delays and
+probabilities stretches the windows where concurrent lanes interleave —
+a caller lands in the *next* wave instead of this one, a carried job
+parks across a wave boundary, a result splice completes after a later
+wave already launched.  On top of that, every caller thread follows a
+seed-derived jitter schedule, so a given ``--seed`` replays the same
+adversarial traffic shape run over run (faultpoint RNG streams are
+per-point seeded — faults.py "Determinism").
+
+The default scenario is the concurrent COLD-KEY conservation check
+(ROADMAP: the one correctness debt found by PR 5's chaos soak): a
+3-daemon in-proc cluster, N threads hammering a small set of
+brand-new keys with 1-row wire batches through daemons 0 AND 1
+concurrently, **no pre-warm**, then an exact audit — every hit sent
+must be debited from its key's bucket, cluster-wide.  At the pre-fix
+commit this FAILS for every seed (forwarded rows applied at the
+owner's wall clock while local rows applied at the caller's ``now``:
+two time bases in one bucket row, and the later base reads the
+earlier-base row as expired → bucket reset → debits silently gone).
+Post-fix (created_at forwarding, proto field 10) it passes for every
+seed.
+
+Usage:
+    python tools/racer.py --seed 7
+    python tools/racer.py --seed 7 --runs 3 --threads 16 --keys 10
+    python tools/racer.py --seed 7 --warm     # control: pre-warmed keys
+
+Exit status: 0 = exact conservation on every run; 1 = hits lost (the
+per-key shortfall is printed).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DAY = 24 * 3_600_000
+#: pinned time base for the run — deliberately far from the wall clock,
+#: so any lane that silently substitutes its own clock for the caller's
+#: time base turns the substitution into a visible conservation break
+#: (exactly how the cold-key loss was found)
+NOW0 = 1_760_000_000_000
+LIMIT = 10 ** 6
+
+
+def serialize(reqs):
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    msg = pb.GetRateLimitsReq()
+    for r in reqs:
+        m = msg.requests.add()
+        m.name = r.name
+        m.unique_key = r.unique_key
+        m.hits = r.hits
+        m.limit = r.limit
+        m.duration = r.duration
+        m.algorithm = int(r.algorithm)
+        m.behavior = int(r.behavior)
+        m.burst = r.burst
+    return msg.SerializeToString()
+
+
+def one_req(hits, key, name):
+    from gubernator_tpu.types import RateLimitRequest
+
+    return serialize([RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=LIMIT,
+        duration=DAY)])
+
+
+def fault_spec(rng: random.Random) -> str:
+    """Seed-derived preemption schedule: each dispatcher merge/carry/
+    splice point sleeps a small seed-chosen time with a seed-chosen
+    probability.  Delays are ms-scale — enough to push a concurrent
+    caller into the next wave, small enough that a run stays fast."""
+    parts = []
+    for point in ("dispatch_merge", "dispatch_carry", "dispatch_splice"):
+        delay_ms = rng.choice((1, 2, 3, 5))
+        prob = rng.choice((0.2, 0.35, 0.5))
+        parts.append(f"{point}:delay:{delay_ms}ms:{prob}")
+    return ",".join(parts)
+
+
+def run_once(seed: int, run_idx: int, threads: int, keys_n: int,
+             reps: int, hits: int, warm: bool, verbose: bool) -> dict:
+    from gubernator_tpu import cluster as cluster_mod
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    rng = random.Random(f"racer|{seed}|{run_idx}")
+    tag = f"s{seed}r{run_idx}"
+    name = f"racer-{tag}"
+    keys = [f"racer-{tag}-k{i}" for i in range(keys_n)]
+    spec = fault_spec(rng)
+    c = cluster_mod.start(3)
+    try:
+        # warm each ENGINE with an unrelated key so the first wave's
+        # compile cost doesn't serialize the whole schedule; the keys
+        # under test stay COLD unless --warm asked for the control run
+        for d in range(3):
+            c.instance_at(d).get_rate_limits_wire(
+                one_req(0, f"racer-{tag}-warmup", name), now_ms=NOW0)
+        if warm:
+            for d in range(3):
+                for k in keys:
+                    c.instance_at(d).get_rate_limits_wire(
+                        one_req(0, k, name), now_ms=NOW0)
+        # arm the seeded preemption schedule on every daemon (per-point
+        # RNG streams replay bit-for-bit for a given seed)
+        for d in range(3):
+            c.instance_at(d).faults.arm(spec, seed=seed)
+        if verbose:
+            print(f"  armed: {spec}")
+
+        errs: list = []
+        barrier = threading.Barrier(threads)
+        # per-thread seeded jitter schedule, drawn up front so the
+        # traffic shape is a pure function of the seed
+        jitter = [[rng.random() * 0.004 for _ in range(reps)]
+                  for _ in range(threads)]
+
+        def worker(t):
+            import time as _time
+
+            inst = c.instance_at(t % 2)  # daemons 0 AND 1
+            try:
+                barrier.wait(timeout=60)
+                for r in range(reps):
+                    _time.sleep(jitter[t][r])
+                    out = pb.GetRateLimitsResp.FromString(
+                        inst.get_rate_limits_wire(
+                            one_req(hits, keys[(t + r) % keys_n], name),
+                            now_ms=NOW0 + 1 + r))
+                    if out.responses[0].error:
+                        raise RuntimeError(out.responses[0].error)
+            except Exception as e:  # noqa: BLE001 - audited below
+                errs.append(repr(e))
+
+        ths = [threading.Thread(target=worker, args=(t,))
+               for t in range(threads)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=180)
+        stuck = any(th.is_alive() for th in ths)
+        for d in range(3):
+            c.instance_at(d).faults.clear()
+        if stuck:
+            return {"ok": False, "why": "stuck caller threads"}
+        if errs:
+            return {"ok": False, "why": f"caller errors: {errs[:3]}"}
+        sent = threads * reps * hits
+        debits = {}
+        for k in keys:
+            q = pb.GetRateLimitsResp.FromString(
+                c.instance_at(0).get_rate_limits_wire(
+                    one_req(0, k, name), now_ms=NOW0 + 1000))
+            if q.responses[0].error:
+                return {"ok": False,
+                        "why": f"audit error: {q.responses[0].error}"}
+            debits[k] = LIMIT - int(q.responses[0].remaining)
+        total = sum(debits.values())
+        ok = total == sent
+        out = {"ok": ok, "sent": sent, "debited": total,
+               "lost": sent - total}
+        if not ok:
+            out["per_key"] = {k.rsplit("-", 1)[1]: v
+                              for k, v in debits.items()}
+        return out
+    finally:
+        c.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded interleaving harness (conservation oracle)")
+    ap.add_argument("--seed", type=int, required=True,
+                    help="schedule seed: same seed → same preemption "
+                         "delays, probabilities, and caller jitter")
+    ap.add_argument("--runs", type=int, default=1,
+                    help="independent cluster runs (default 1)")
+    ap.add_argument("--threads", type=int, default=16)
+    ap.add_argument("--keys", type=int, default=10,
+                    help="cold keys under test (default 10)")
+    ap.add_argument("--reps", type=int, default=4,
+                    help="calls per thread (default 4)")
+    ap.add_argument("--hits", type=int, default=2)
+    ap.add_argument("--warm", action="store_true",
+                    help="pre-warm every key on every daemon first "
+                         "(the control that masked the bug)")
+    ap.add_argument("--no-created-at", action="store_true",
+                    help="disable caller-clock forwarding "
+                         "(GUBER_CREATED_AT_FWD=0): reproduces the "
+                         "pre-fix cold-key conservation loss")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.no_created_at:
+        os.environ["GUBER_CREATED_AT_FWD"] = "0"
+        print("caller-clock forwarding DISABLED "
+              "(GUBER_CREATED_AT_FWD=0): expecting the pre-fix loss")
+    failures = 0
+    for i in range(args.runs):
+        r = run_once(args.seed, i, args.threads, args.keys, args.reps,
+                     args.hits, args.warm, args.verbose)
+        if r["ok"]:
+            print(f"run {i}: OK   sent={r['sent']} debited={r['debited']}"
+                  f" (seed {args.seed})")
+        else:
+            failures += 1
+            detail = r.get("why") or (
+                f"sent={r['sent']} debited={r['debited']} "
+                f"LOST={r['lost']} per_key={r.get('per_key')}")
+            print(f"run {i}: LOSS {detail}")
+    if failures:
+        print(f"{failures}/{args.runs} runs broke conservation "
+              f"(seed {args.seed})")
+        return 1
+    print(f"conservation exact over {args.runs} run(s) at seed "
+          f"{args.seed}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

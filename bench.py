@@ -1621,6 +1621,91 @@ def _sec_pallas():
     return {"11_pallas_serving": row}
 
 
+def _sec_mesh():
+    """Pod-coherent GLOBAL over the mesh (ISSUE 7): the same seeded
+    GLOBAL wire traffic served twice — GUBER_GLOBAL_MODE=mesh (the
+    collective-reconcile tier, zero gRPC peer RPCs) vs grpc (the
+    reference hit-queue path, hot set off so the sharded table serves)
+    — with the A/B bit-identity, exact-conservation verdict, reconcile
+    generations, and measured coherence staleness recorded in the row."""
+    import jax
+
+    from gubernator_tpu.config import BehaviorConfig, Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.types import Behavior, RateLimitRequest
+
+    sync_ms = 100
+    reps = 4 if FAST else 16
+    rng = np.random.default_rng(7)
+    # bounded key domain: every key pins into the mesh tier (the row
+    # measures the collective path, not pin-fail fallbacks)
+    batches = [[RateLimitRequest(
+        name="mesh", unique_key=f"g{int(k) % 512}", hits=1, limit=10 ** 9,
+        duration=600_000, behavior=Behavior.GLOBAL)
+        for k in rng.zipf(ZIPF_A, size=1000)] for _ in range(4)]
+    datas = _serialize_reqs(batches)
+
+    def _drive(inst):
+        inst.get_rate_limits_wire(datas[0], now_ms=NOW0)  # compile/pin
+        t0 = time.perf_counter()
+        outs = []
+        for r in range(reps):
+            outs.append(inst.get_rate_limits_wire(
+                datas[r % len(datas)], now_ms=NOW0 + 1 + r))
+        return reps * 1000 / (time.perf_counter() - t0), outs
+
+    row = {"n_shards": len(jax.devices()), "batch": 1000,
+           "key_domain": 512, "reconcile_interval_ms": sync_ms}
+    mi = V1Instance(Config(cache_size=1 << 14, sweep_interval_ms=0,
+                           global_mode="mesh",
+                           behaviors=BehaviorConfig(
+                               global_sync_wait_ms=sync_ms)),
+                    mesh=make_mesh())
+    try:
+        dps_mesh, mesh_outs = _drive(mi)
+        mi._mesh_reconcile_tick()  # deterministic final fold
+        mge = mi._meshglobal
+        mge.drain()
+        s = mge.stats()
+        gm = mi.global_manager
+        row.update({
+            "decisions_per_s": round(dps_mesh),
+            "reconcile_generations": s["generation"],
+            "pinned_keys": s["pinned_keys"],
+            "staleness_ms": round(s["last_staleness_s"] * 1e3, 3),
+            "staleness_within_interval":
+                s["last_staleness_s"] * 1e3 <= sync_ms,
+            "conservation_exact":
+                s["folded_hits"] == s["injected_hits"],
+            "injected_hits": s["injected_hits"],
+            # mesh mode's whole point: nothing ever queued for gRPC
+            "zero_peer_rpcs": (not gm._hits and not gm._hits_raw),
+        })
+    finally:
+        mi.close()
+    gi = V1Instance(Config(cache_size=1 << 14, sweep_interval_ms=0,
+                           hot_set_capacity=0),
+                    mesh=make_mesh())
+    try:
+        dps_grpc, grpc_outs = _drive(gi)
+        row["grpc_decisions_per_s"] = round(dps_grpc)
+        row["ab_identical"] = grpc_outs == mesh_outs
+        row["mesh_vs_grpc"] = round(dps_mesh / max(dps_grpc, 1e-9), 3)
+    finally:
+        gi.close()
+    if jax.default_backend() == "cpu":
+        row["context"] = (
+            "CPU A/B compares the mesh replica step against the "
+            "IN-PROCESS sharded step (grpc mode never leaves the "
+            "process here), so the ratio measures replica-table "
+            "overhead only; the production win is vs per-peer gRPC "
+            "round trips, which this host-only A/B cannot price. The "
+            "coherence columns (conservation/staleness/zero RPCs) are "
+            "the acceptance signal")
+    return {"12_mesh_global": row}
+
+
 #: section name → (callable, result row keys for skip/error reporting)
 _SECTIONS = {
     "lat_client": (_sec_lat_client,
@@ -1634,11 +1719,12 @@ _SECTIONS = {
     "hot": (_sec_hot, ["7_hot_psum"]),
     "cfg5": (_sec_cfg5, ["5_gregorian_churn"]),
     "pallas": (_sec_pallas, ["11_pallas_serving"]),
+    "mesh": (_sec_mesh, ["12_mesh_global"]),
 }
 
 #: device sections that each pay a fresh compile, in run order
 _SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot",
-                  "cfg5", "pallas"]
+                  "cfg5", "pallas", "mesh"]
 
 _WEDGED = False  # set when a section timeout + failed device probe
 #: parent's backend, captured BEFORE the device client is released —

@@ -50,7 +50,8 @@ import numpy as np
 #: wave (job queue wait, wire ingest, response build, peer flush).
 IN_WAVE_PHASES = ("pack", "device", "resolve")
 PHASES = ("ingest", "pack", "queue_wait", "device", "resolve", "build",
-          "peer_flush", "broadcast", "snapshot", "restore")
+          "peer_flush", "broadcast", "snapshot", "restore",
+          "global_fold")
 
 
 def _env_int(name: str, default: int, lo: int = 1) -> int:
@@ -318,6 +319,21 @@ class HeavyHitterSketch:
         if self._used < self.width:
             return 0
         return int(self._cnt[: self._used].min())
+
+    def count_of(self, khash: int) -> int:
+        """Tracked count for one key hash (0 when untracked) — the
+        hot-set promoter's feed (ROADMAP: promotion driven by the
+        sketch's signal instead of ad-hoc counting).  An overestimate
+        by at most the key's ``err``, which only makes promotion
+        eager, never starved."""
+        self._reindex()
+        if not self._sorted_kh.size:
+            return 0
+        kh = np.uint64(khash)
+        pos = int(np.searchsorted(self._sorted_kh, kh))
+        if pos >= self._sorted_kh.size or self._sorted_kh[pos] != kh:
+            return 0
+        return int(self._cnt[self._sorted_slot[pos]])
 
     def topk(self, k: Optional[int] = None) -> List[dict]:
         k = self.k if k is None else max(int(k), 1)
@@ -625,6 +641,13 @@ class KeyAnalytics:
         if ok:
             self._publish()
         return ok
+
+    def sketch_count(self, khash: int) -> int:
+        """Thread-safe tracked-count read for one key hash (0 when
+        untracked) — the hot-set promotion feed (instance.py ›
+        _count_toward_promotion)."""
+        with self._mu:
+            return self.sketch.count_of(khash)
 
     def stats(self) -> dict:
         with self._mu:

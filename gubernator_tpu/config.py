@@ -67,6 +67,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_FAULT_SEED": "fault-injection RNG seed for bit-for-bit chaos replay",
     "GUBER_GLOBAL_BATCH_LIMIT": "GLOBAL hit-flush batch limit",
     "GUBER_GLOBAL_BROADCAST_INTERVAL": "GLOBAL owner-broadcast tick interval (duration)",
+    "GUBER_GLOBAL_MODE": "GLOBAL reconcile backend: grpc (default) or mesh (pod-local collective fold)",
     "GUBER_GLOBAL_SYNC_WAIT": "GLOBAL hit-flush coalescing wait (duration)",
     "GUBER_GLOBAL_TIMEOUT": "GLOBAL flush RPC timeout (duration)",
     "GUBER_GRPC_ADDRESS": "gRPC listen address",
@@ -81,6 +82,8 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_KSPLIT": "device step: probe K-split override (core/step.py)",
     "GUBER_LOG_LEVEL": "root log level",
     "GUBER_MEMBERLIST_KNOWN_HOSTS": "memberlist discovery: seed hosts",
+    "GUBER_MESH_FALLBACK_AFTER": "consecutive mesh-GLOBAL fold failures before the tier stands down to the gRPC path",
+    "GUBER_MESH_GLOBAL_CAP": "mesh-GLOBAL replica table capacity (keys; power of two)",
     "GUBER_MULTI_REGION_BATCH_LIMIT": "cross-region replication batch limit",
     "GUBER_MULTI_REGION_SYNC_WAIT": "cross-region flush coalescing wait (duration)",
     "GUBER_MULTI_REGION_TIMEOUT": "cross-region flush RPC timeout (duration)",
@@ -258,6 +261,14 @@ class Config:
     #: auto-grow — parallel/pallas_engine.py).  GUBER_STEP_IMPL
     #: overrides.
     step_impl: str = ""
+    #: GLOBAL reconcile backend (ISSUE 7): "" / "grpc" keeps the
+    #: reference's hit-queue + broadcast machinery; "mesh" serves
+    #: pod-local GLOBAL keys from the mesh-resident replica tier
+    #: (parallel/meshglobal.py) and reconciles them with ONE collective
+    #: fold per tick — no gRPC peer fan-out.  Cross-pod owners and the
+    #: degraded fallback keep the gRPC lanes either way.
+    #: GUBER_GLOBAL_MODE overrides.
+    global_mode: str = ""
     #: Replicated hot-set capacity for GLOBAL keys (0 disables the psum
     #: tier; see parallel/hotset.py).  Active only for pod-local
     #: deployments (no cross-host peers).
@@ -354,6 +365,9 @@ class DaemonConfig:
     #: Decision-step implementation ("" → "xla"; "pallas" = the Mosaic
     #: kernel serving mode — Config.step_impl).
     step_impl: str = ""
+    #: GLOBAL reconcile backend ("" → "grpc"; "mesh" = pod-local
+    #: collective fold — Config.global_mode).
+    global_mode: str = ""
 
     def instance_config(self) -> Config:
         return Config(
@@ -361,6 +375,7 @@ class DaemonConfig:
             cache_autogrow_max=self.cache_autogrow_max,
             batch_rows=self.batch_rows,
             step_impl=self.step_impl,
+            global_mode=self.global_mode,
             handover_on_reshard=self.handover_on_reshard,
             behaviors=self.behaviors,
             data_center=self.data_center,
@@ -443,6 +458,7 @@ def setup_daemon_config(conf_file: str = "",
     d.log_level = src.get("GUBER_LOG_LEVEL", d.log_level)
     d.snapshot_path = src.get("GUBER_SNAPSHOT_PATH", d.snapshot_path)
     d.step_impl = src.get("GUBER_STEP_IMPL", d.step_impl)
+    d.global_mode = src.get("GUBER_GLOBAL_MODE", d.global_mode)
 
     b = d.behaviors
     b.batch_timeout_ms = src.get("GUBER_BATCH_TIMEOUT", b.batch_timeout_ms,

@@ -204,6 +204,13 @@ class Dispatcher:
         self.metrics = metrics
         self.recorder = recorder
         self._clock = clock
+        #: mesh-GLOBAL reconcile generation (ISSUE 7): bumped by the
+        #: instance after each collective fold; every wave is stamped
+        #: with the generation it served under, so a decision window
+        #: correlates with the coherence epoch it read.  Single racy
+        #: int write/read by design (a wave straddling a fold may carry
+        #: either stamp — both are true).
+        self.reconcile_gen = 0
         # --- wave telemetry state (all under _tel_mu) ---
         self._tel_mu = threading.Lock()
         #: wave_id → {t0, kind, size, trace, stalled}
@@ -581,12 +588,14 @@ class Dispatcher:
             from .tracing import current_trace_id
 
             trace = current_trace_id()
+        gen = self.reconcile_gen
         with self._tel_mu:
             self._wave_seq += 1
             wid = self._wave_seq
             self._inflight[wid] = {"t0": t0, "kind": kind, "size": nreq,
                                    "trace": trace, "stalled": False,
-                                   "slot": slot, "marks": []}
+                                   "slot": slot, "gen": gen,
+                                   "marks": []}
             self._recent_sizes.append(nreq)
             self._recent_waits.extend(waits)
         if self.metrics is not None:
@@ -599,6 +608,9 @@ class Dispatcher:
         if self.recorder is not None:
             ev = {"trace": trace, "wave": wid, "wave_kind": kind,
                   "size": nreq, "jobs": len(jobs) if jobs else 1}
+            if gen:
+                # mesh-GLOBAL coherence epoch this wave served under
+                ev["gen"] = gen
             if slot is not None:
                 # pipeline slot this launch occupies (0 = the oldest
                 # in-flight wave) — correlates stalls with ring depth
@@ -704,6 +716,8 @@ class Dispatcher:
             ev = {"trace": info["trace"], "wave": wid,
                   "wave_kind": info["kind"], "size": info["size"],
                   "duration_ms": round(dur * 1000, 3)}
+            if info.get("gen"):
+                ev["gen"] = info["gen"]
             if info.get("slot") is not None:
                 ev["slot"] = info["slot"]
             if phases is not None:

@@ -87,6 +87,15 @@ FAULT_POINTS = {
                         "owner broadcast tick",
     "global_hits": "GlobalManager._run_async_hits — before the hit "
                    "flush tick (failed aggregates requeue)",
+    "global_accum_swap": "V1Instance._mesh_reconcile_tick — before the "
+                         "mesh-GLOBAL accumulator double-buffer swap "
+                         "(error aborts the tick; buffers untouched)",
+    "global_psum": "V1Instance._mesh_reconcile_tick — before the "
+                   "mesh-GLOBAL reconcile collective launches (error "
+                   "swaps the retired buffer back; no hit stranded)",
+    "mr_sync": "MultiRegionManager._run_async_reqs — before the "
+               "cross-region flush tick (queues not yet popped, so an "
+               "aborted tick loses nothing)",
     "snapshot": "instance._save_to_loader — before the Loader snapshot",
     "restore": "instance._load_from_loader — before the Loader restore",
 }

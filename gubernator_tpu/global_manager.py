@@ -205,6 +205,15 @@ class GlobalManager:
         legacy object flush."""
         if self._fault_tick("global_hits", "global hits flush"):
             return
+        # Mesh reconcile backend (ISSUE 7, GUBER_GLOBAL_MODE=mesh):
+        # pod-local GLOBAL counters converge through the engine-side
+        # collective fold instead of gRPC fan-out; the tick no-ops in
+        # grpc mode.  The queued aggregates below (cross-pod owners,
+        # degraded-mode reconcile) keep the gRPC lanes either way —
+        # that path is also the mesh tier's degraded fallback.
+        tick = getattr(self.instance, "_mesh_reconcile_tick", None)
+        if tick is not None:
+            tick()
         with self._mu:
             hits, self._hits = self._hits, {}
             hits_raw, self._hits_raw = self._hits_raw, {}

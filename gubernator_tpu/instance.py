@@ -204,8 +204,32 @@ class V1Instance:
         self.global_manager: Optional[GlobalManager] = None
         self.mr_manager: Optional[MultiRegionManager] = None
         self._gm_mu = threading.Lock()
+        # GLOBAL reconcile backend (ISSUE 7): "grpc" keeps the
+        # reference's hit-queue/broadcast machinery; "mesh" serves
+        # pod-local GLOBAL keys from the mesh-resident replica tier
+        # (parallel/meshglobal.py) and reconciles with ONE collective
+        # fold per GlobalSyncWait tick — zero gRPC peer fan-out.  The
+        # gRPC path stays for cross-pod owners and as the degraded
+        # fallback when the fold is unhealthy.
+        global_mode = (os.environ.get("GUBER_GLOBAL_MODE")
+                       or config.global_mode or "grpc")
+        if global_mode not in ("grpc", "mesh"):
+            # a typo must not silently serve the wrong coherence model
+            raise ValueError(
+                f"unknown global_mode {global_mode!r} (want 'grpc' or "
+                "'mesh')")
+        self._global_mode = global_mode
+        self._meshglobal = None
+        #: single-writer state (the GlobalManager hits-loop thread owns
+        #: the reconcile tick); request threads only read — a stale
+        #: read routes one batch the conservative (sharded) way
+        self._mesh_fail_streak = 0  # lock-free: tick-thread only
+        self._mesh_degraded = False  # lock-free: single racy bool
+        self._mesh_down_until = 0.0  # lock-free: single racy float
         # Replicated hot-set (psum GLOBAL tier, parallel/hotset.py):
-        # lazily built on first promotion; pod-local only.
+        # lazily built on first promotion; pod-local only.  Unused in
+        # mesh mode (the mesh tier serves ALL qualifying GLOBAL keys —
+        # two replica tiers for one key would double-count).
         self._hotset = None
         self._hot_mu = threading.Lock()
         #: key_hash → weight
@@ -224,6 +248,16 @@ class V1Instance:
         self.loader = config.loader
         if self.loader is not None:
             self._load_from_loader()
+        if self._mesh_mode():
+            # the reconcile tick rides the GlobalManager's hits loop
+            # (its mesh backend) — start it now so folds run even
+            # before any gRPC-lane work would have built the manager
+            self._ensure_global_manager()
+            # pre-compile the mesh tier's step + fold NOW: a lazy
+            # first-touch compile would land inside a caller's GLOBAL
+            # request, long enough (CPU: seconds) to idle-expire
+            # short-duration buckets before their second request
+            self._ensure_meshglobal().warmup()
 
     # ---- persistence wiring (store.go › Loader/Store) ------------------
 
@@ -249,9 +283,10 @@ class V1Instance:
             return
         self._fault_point("snapshot")
         t0 = time.perf_counter()
-        # hot-set rows live outside the sharded table; fold them back in
-        # so the snapshot is complete
+        # hot-set / mesh-tier rows live outside the sharded table; fold
+        # them back in so the snapshot is complete
         self._demote_all()
+        self._mesh_demote_all()
         self.loader.save(iter(items_from_arrays(self.engine.snapshot())))
         self.dispatcher._obs_phase("snapshot", time.perf_counter() - t0)
 
@@ -300,6 +335,8 @@ class V1Instance:
                           for info in infos)
         if have_others:
             self._demote_all()
+            # the mesh-GLOBAL tier is pod-local by the same rule
+            self._mesh_demote_all()
         # Stateful re-sharding (beyond-reference, opt-in): the
         # reference resets re-homed keys (SURVEY.md §5.3); with the
         # flag on, rows whose ring owner moved are handed to the new
@@ -1146,6 +1183,17 @@ class V1Instance:
         All gating runs here, before any state mutation, so a None
         return leaves the instance untouched for the fallback.
         """
+        if self._global_mode == "mesh":
+            # mesh backend (ISSUE 7): qualifying rows ride the mesh
+            # tier; degraded/stood-down (or anything the columnar
+            # mesh runner can't model) serves owner-sharded — always
+            # correct, reconciled by the gRPC queues
+            if self._mesh_routable():
+                runner = self._wire_mesh_runner(parsed, now)
+                if runner is not None:
+                    return runner
+                return None  # pinned-key demote case: object path
+            return lambda: self._wire_check_columns(parsed, now)
         if self.config.hot_set_capacity <= 0:
             # tier disabled: solo GLOBAL is just the local path (the
             # object path's queue_update broadcasts to no one)
@@ -1253,6 +1301,107 @@ class V1Instance:
             self.metrics.over_limit_counter.inc(int((status == 1).sum()))
             if self._promote_pending:
                 self._drain_promotions(now)
+            return _wire_native.build_rate_limit_resps(
+                status, lim_o, rem, rst, errors)
+
+        return run
+
+    def _wire_mesh_runner(self, parsed: dict, now: int):
+        """Columnar mesh-GLOBAL flow (ISSUE 7; the wire-lane twin of
+        ``_mesh_route``): qualifying GLOBAL rows serve on the
+        mesh-resident replica tier — pinned on first touch, in ONE
+        batched upload — everything else rides the sharded step.
+        Returns a zero-argument executor, or None when a pinned key's
+        config changed (the object path demotes it with state intact,
+        exactly the hot set's fallback contract)."""
+        from .core.batch import pack_columns
+        from .hashing import mix64_np
+
+        n = parsed["n"]
+        kh = mix64_np(parsed["khash_raw"])
+        kh = np.where(kh == 0, np.uint64(1), kh)
+        batch, errs = pack_columns(
+            kh, parsed["hits"], parsed["limit"], parsed["duration"],
+            parsed["algorithm"], parsed["behavior"], parsed["burst"],
+            now, created_at=parsed.get("created_at"))
+        beh = np.asarray(batch.behavior)
+        glob_mask = (beh & int(Behavior.GLOBAL)) != 0
+        excluded = (beh & int(self._HOT_EXCLUDED)) != 0
+        mesh_mask = glob_mask & ~excluded & np.asarray(batch.valid)
+        mge = self._ensure_meshglobal()
+        if mesh_mask.any():
+            alg = np.asarray(batch.algorithm)
+            lim = np.asarray(batch.limit)
+            dur = np.asarray(batch.duration)
+            bur = np.asarray(batch.burst)
+            hits_col = np.asarray(batch.hits)
+            pins: List[tuple] = []
+            for k in np.unique(kh[mesh_mask]):
+                ik = int(k)
+                m = mesh_mask & (kh == k)
+                i = int(np.nonzero(m)[0][0])
+                # one config per key per batch (pinned OR to-pin): a
+                # mid-batch config change takes the object path, which
+                # demotes/serves it per request with exact semantics
+                if not ((alg[m] == alg[i]).all()
+                        and (lim[m] == lim[i]).all()
+                        and (dur[m] == dur[i]).all()
+                        and (bur[m] == bur[i]).all()):
+                    return None
+                proto = RateLimitRequest(
+                    name="", unique_key="", hits=int(hits_col[i]),
+                    limit=int(lim[i]), duration=int(dur[i]),
+                    algorithm=int(alg[i]), behavior=int(beh[i]),
+                    burst=int(bur[i]))
+                if mge.is_pinned(ik):
+                    if not mge.matches_pinned(ik, proto):
+                        return None  # config changed → demote path
+                else:
+                    pins.append((proto, ik, self._seed_row(ik)))
+            if pins:
+                ok = mge.pin_many(pins, now)
+                for (_p, ik, _s), good in zip(pins, ok):
+                    if not good:  # probe window full → sharded path
+                        mesh_mask = mesh_mask & (kh != np.uint64(ik))
+
+        def run() -> bytes:
+            status = np.zeros(n, np.int64)
+            rem = np.zeros(n, np.int64)
+            rst = np.zeros(n, np.int64)
+            lim_o = np.zeros(n, np.int64)
+            errors: Optional[list] = None
+            shard_mask = ~mesh_mask
+            if shard_mask.any():
+                idx = np.nonzero(shard_mask)[0]
+                sub = type(batch)(*[np.asarray(c)[idx] for c in batch])
+                s_st, s_lim, s_rem, s_rst, s_full = \
+                    self.dispatcher.check_packed(sub, kh[idx], now)
+                status[idx] = s_st
+                lim_o[idx] = s_lim
+                rem[idx] = s_rem
+                rst[idx] = s_rst
+                if s_full.any():
+                    errors = [None] * n
+                    for j in np.nonzero(s_full)[0]:
+                        errors[int(idx[j])] = "rate limit table full"
+            if mesh_mask.any():
+                idx = np.nonzero(mesh_mask)[0]
+                sub = type(batch)(*[np.asarray(c)[idx] for c in batch])
+                m_st, m_rem, m_rst, m_lim, m_lost = mge.check_columns(
+                    sub, kh[idx], now)
+                status[idx] = m_st
+                rem[idx] = m_rem
+                rst[idx] = m_rst
+                lim_o[idx] = m_lim
+                if m_lost.any():
+                    errors = errors or [None] * n
+                    for j in np.nonzero(m_lost)[0]:
+                        errors[int(idx[j])] = "mesh-global row lost"
+            if errs:
+                errors = errors or [None] * n
+                for i, emsg in errs.items():
+                    errors[i] = emsg
+            self.metrics.over_limit_counter.inc(int((status == 1).sum()))
             return _wire_native.build_rate_limit_resps(
                 status, lim_o, rem, rst, errors)
 
@@ -1651,6 +1800,7 @@ class V1Instance:
         responses: List[Optional[RateLimitResponse]] = [None] * n
         local_idx: List[int] = []
         hot: List[tuple[int, int]] = []  # (request idx, key hash)
+        meshl: List[tuple[int, int]] = []  # mesh-GLOBAL (idx, key hash)
         solo = None  # lazily: are we the only daemon (hot tier eligible)?
         fwd: List[tuple[int, PeerClient, RateLimitRequest]] = []
 
@@ -1688,7 +1838,17 @@ class V1Instance:
                 if solo is None:
                     solo = not have_peers or all(
                         self.is_self(p) for p in self.peers())
-                if solo and self._hot_route(req, hot, i):
+                if solo and self._global_mode == "mesh":
+                    # mesh backend (ISSUE 7): ALL qualifying GLOBAL
+                    # keys ride the mesh-resident replica tier; the
+                    # hot set stays out of the picture (two replica
+                    # tiers for one key would double-count).  A False
+                    # return (excluded flags, window full, degraded
+                    # stand-down) takes the owner-sharded path below.
+                    if self._mesh_routable() and \
+                            self._mesh_route(req, meshl, i, now):
+                        continue
+                elif solo and self._hot_route(req, hot, i):
                     continue
                 # Otherwise: answer from the local replica now, reconcile
                 # hits to the owner asynchronously (global.go semantics).
@@ -1756,6 +1916,18 @@ class V1Instance:
                     f = Future()
                     f.set_exception(e)
             futures.append((i, f, peer.info.grpc_address, req))
+
+        if meshl:
+            m_reqs = [reqs[i] for i, _ in meshl]
+            m_resps = self._meshglobal.check_batch(
+                m_reqs, [h for _, h in meshl], now)
+            for (i, _), resp in zip(meshl, m_resps):
+                responses[i] = resp
+                if resp.status == Status.OVER_LIMIT:
+                    self.metrics.over_limit_counter.inc()
+            # Store write-through covers mesh keys too (home-replica
+            # values are exact; the fold converges the other replicas)
+            self._after_local(m_reqs, m_resps)
 
         if hot:
             hot_reqs = [reqs[i] for i, _ in hot]
@@ -1893,10 +2065,23 @@ class V1Instance:
         """Promotion bookkeeping, keyed by key hash (guarded: concurrent
         handlers must not double-promote or KeyError on the shared
         counter dict).  ``req`` carries the (limit, duration, algorithm,
-        burst) the pin will adopt."""
+        burst) the pin will adopt.
+
+        The promotion SIGNAL is the Space-Saving heavy-hitter ledger
+        (``/debug/topkeys``, analytics.py) when analytics is on — the
+        PR-4 ROADMAP hook: the sketch sees every lane's resolved waves
+        (including columnar wire traffic this counter never did), so a
+        key hot through any path promotes.  The decayed ad-hoc counter
+        stays as the floor: the sketch's paced async folds must never
+        STARVE promotion (tap shedding under overload), only feed it."""
+        ana = self.analytics
         with self._hot_mu:
             c = self._hot_counts.get(kh, 0) + weight
             self._hot_counts[kh] = c
+            if ana is not None:
+                # sketch count is an overestimate by ≤ its err bound —
+                # promotion can only get more eager, never starved
+                c = max(c, ana.sketch_count(kh))
             if c >= self.config.hot_promote_threshold:
                 # promote AFTER this batch's device step so the seed
                 # row includes this request's own hits
@@ -1989,6 +2174,181 @@ class V1Instance:
                     self.config.behaviors.global_sync_wait_ms,
                     self._hotset.sync, name="hotset-psum-sync")
             return self._hotset
+
+    # ---- mesh-resident GLOBAL (ISSUE 7, parallel/meshglobal.py) --------
+
+    def _mesh_mode(self) -> bool:
+        """True when the mesh reconcile backend is selected AND the
+        engine exposes a mesh (injected test engines may not)."""
+        return (self._global_mode == "mesh"
+                and getattr(self.engine, "mesh", None) is not None)
+
+    def _mesh_routable(self) -> bool:
+        """Mesh routing is pod-local (the hot set's rule: no non-self
+        peers) and stands down while the fold is degraded — then the
+        owner-sharded path + gRPC queues serve, which is always
+        correct, just slower to cohere."""
+        if not self._mesh_mode() or self._mesh_degraded:
+            return False
+        peers = self.peers()
+        return not peers or all(self.is_self(p) for p in peers)
+
+    def _ensure_meshglobal(self):
+        with self._gm_mu:
+            if self._meshglobal is None:
+                from .parallel.meshglobal import MeshGlobalEngine
+
+                raw = os.environ.get("GUBER_MESH_GLOBAL_CAP", "")
+                try:
+                    cap = int(raw) if raw else 4096
+                except ValueError:
+                    cap = 4096
+                cap = 1 << max((cap - 1).bit_length(), 4)
+                self._meshglobal = MeshGlobalEngine(
+                    self.engine.mesh, capacity=cap,
+                    batch_per_chip=self.config.batch_rows)
+            return self._meshglobal
+
+    @staticmethod
+    def _mesh_fallback_after() -> int:
+        raw = os.environ.get("GUBER_MESH_FALLBACK_AFTER", "")
+        try:
+            return max(int(raw), 1) if raw else 3
+        except ValueError:
+            return 3
+
+    def _seed_row(self, kh: int) -> Optional[dict]:
+        """The key's sharded-table row, for pin seeding (promotion into
+        the mesh tier must not forget hits already consumed)."""
+        with self._engine_mu:
+            found, cols = self.engine.gather_rows(
+                np.array([kh], np.uint64))
+        if not found[0]:
+            return None
+        return {f: int(cols[f][0])
+                for f in ("remaining", "t_ms", "expire_at", "meta")}
+
+    def _mesh_route(self, req: RateLimitRequest, mesh_list, i,
+                    now: int) -> bool:
+        """Route a qualifying GLOBAL request to the mesh tier: pin on
+        first touch (seeded from the sharded row), demote on config
+        change or excluded flags.  Returns True when routed; False
+        sends the request down the standard (owner-sharded) path."""
+        qualifies = not int(req.behavior) & int(self._HOT_EXCLUDED)
+        kh = hash_key(req.name, req.unique_key)
+        mge = self._ensure_meshglobal()
+        if mge.is_pinned(kh):
+            if not qualifies or not mge.matches_pinned(kh, req):
+                self._mesh_demote(kh)
+                return False
+            mesh_list.append((i, kh))
+            return True
+        if not qualifies:
+            return False
+        if not mge.pin(req, kh, now, seed=self._seed_row(kh)):
+            return False  # probe window full: sharded path is correct
+        mesh_list.append((i, kh))
+        return True
+
+    def _mesh_demote(self, key_hash: int) -> None:
+        """Migrate one mesh key's HOME-replica row back into the
+        sharded table (exact without any collective — home routing
+        means only the home copy ever moved), then retire its slot."""
+        mge = self._meshglobal
+        if mge is None:
+            return
+        row = mge.row_state(key_hash)
+        if row is not None:
+            cols = {f: np.array([row[f]]) for f in row}
+            with self._engine_mu:
+                self.engine.upsert_rows(
+                    np.array([key_hash], np.uint64), cols)
+        mge.unpin(key_hash)
+
+    def _mesh_demote_all(self) -> None:
+        """Demote every mesh-tier key in one batched writeback (peer
+        join / stand-down / snapshot).  Exact: home-row reads need no
+        collective, so this works even when the fold is the thing
+        that broke."""
+        mge = self._meshglobal
+        if mge is None:
+            return
+        khs = mge.pinned_keys()
+        if not khs:
+            return
+        rows = [(kh, mge.row_state(kh)) for kh in khs]
+        rows = [(kh, r) for kh, r in rows if r is not None]
+        if rows:
+            cols = {f: np.array([r[f] for _, r in rows])
+                    for f in rows[0][1]}
+            with self._engine_mu:
+                self.engine.upsert_rows(
+                    np.array([kh for kh, _ in rows], np.uint64), cols)
+        for kh in khs:
+            mge.unpin(kh)
+
+    def _mesh_reconcile_tick(self) -> None:
+        """The GlobalManager mesh backend's tick: swap the accumulator
+        double buffer, launch the reconcile collective, account
+        staleness/generation, and run the degraded fallback.  Never
+        raises (the hits loop must survive every failure mode)."""
+        if not self._mesh_mode():
+            return
+        mge = self._meshglobal
+        if mge is None:
+            return
+        t0 = time.perf_counter()
+        retired = None
+        try:
+            self._fault_point("global_accum_swap")
+            retired = mge.swap_accum()
+            self._fault_point("global_psum")
+            mge.fold(retired)
+        except Exception as e:  # noqa: BLE001 - incl. FaultInjected
+            if retired is not None:
+                mge.swap_back()  # unfolded hits stay accumulating
+            self.metrics.mesh_global_fold_errors.inc()
+            self._mesh_fail_streak += 1
+            log.warning("mesh-GLOBAL fold failed (streak %d): %s",
+                        self._mesh_fail_streak, exc_text(e))
+            if (self._mesh_fail_streak >= self._mesh_fallback_after()
+                    and not self._mesh_degraded):
+                self._mesh_stand_down()
+            return
+        dt = time.perf_counter() - t0
+        self._mesh_fail_streak = 0
+        self.metrics.mesh_global_folds.inc()
+        self.metrics.mesh_global_staleness.set(mge.last_staleness_s)
+        self.metrics.mesh_global_keys.set(len(mge.slots))
+        # stamp the coherence epoch onto subsequent waves and attribute
+        # the collective's time as its own phase (PhaseLedger)
+        self.dispatcher.reconcile_gen = mge.generation
+        self.dispatcher._obs_phase("global_fold", dt)
+        if (self._mesh_degraded
+                and time.monotonic() >= self._mesh_down_until):
+            # cooldown elapsed AND a clean fold: re-arm the tier
+            self._mesh_degraded = False
+            self.metrics.mesh_global_degraded.set(0)
+            self.recorder.record("mesh_recovered",
+                                 generation=mge.generation)
+
+    def _mesh_stand_down(self) -> None:
+        """Degraded fallback: demote every pinned key back to the
+        owner-sharded path (exact) and route GLOBAL traffic the grpc
+        way until the fold has recovered past the cooldown —
+        bounded-staleness degradation, never unavailability."""
+        cooldown = max(
+            self.config.behaviors.global_sync_wait_ms, 100) * 10 / 1000.0
+        self._mesh_down_until = time.monotonic() + cooldown
+        self._mesh_degraded = True
+        self.metrics.mesh_global_degraded.set(1)
+        self.recorder.record("mesh_degraded",
+                             streak=self._mesh_fail_streak,
+                             cooldown_s=round(cooldown, 3))
+        try:
+            self._mesh_demote_all()
+        except Exception:  # noqa: BLE001 - demotion is best-effort here
+            log.exception("mesh-GLOBAL stand-down demotion")
 
     def _read_through(self, reqs) -> None:
         """Seed table misses from the write-through Store before the
@@ -2248,6 +2608,8 @@ class V1Instance:
         kh = hash_key(name, unique_key)
         if self._hotset is not None and self._hotset.is_pinned(kh):
             self._demote(kh)
+        if self._meshglobal is not None and self._meshglobal.is_pinned(kh):
+            self._mesh_demote(kh)
         with self._engine_mu:
             n = self.engine.remove_rows(np.array([kh], np.uint64))
         if self.store is not None:

@@ -260,6 +260,36 @@ class Metrics:
             "times an armed faultpoint fired (faults.py; 0 in healthy "
             "operation — nonzero means a chaos run is active)",
             ["point"], registry=r)
+        # Mesh-resident GLOBAL (ISSUE 7): the collective reconcile
+        # tier's shape — fold cadence, measured coherence staleness,
+        # and the degraded fallback to the gRPC path must all be
+        # visible, or a silently stood-down tier looks healthy while
+        # every GLOBAL key quietly rides the slow path.
+        self.mesh_global_folds = Counter(
+            "gubernator_mesh_global_folds",
+            "mesh-GLOBAL reconcile collectives completed (one "
+            "all-reduce fold per generation)", registry=r)
+        self.mesh_global_fold_errors = Counter(
+            "gubernator_mesh_global_fold_errors",
+            "mesh-GLOBAL reconcile ticks that failed (accumulators "
+            "swap back — no hit is lost; consecutive failures past "
+            "GUBER_MESH_FALLBACK_AFTER stand the tier down)",
+            registry=r)
+        self.mesh_global_staleness = Gauge(
+            "gubernator_mesh_global_staleness_seconds",
+            "measured coherence staleness at the last mesh-GLOBAL "
+            "fold: age of the oldest hit the collective folded "
+            "(bounded by the reconcile interval when ticks are "
+            "healthy)", registry=r)
+        self.mesh_global_degraded = Gauge(
+            "gubernator_mesh_global_degraded",
+            "1 while the mesh-GLOBAL tier is stood down (keys demoted "
+            "to the owner-sharded path; reconcile rides the gRPC "
+            "queues until the fold recovers)", registry=r)
+        self.mesh_global_keys = Gauge(
+            "gubernator_mesh_global_keys",
+            "keys currently pinned in the mesh-GLOBAL replica table",
+            registry=r)
 
     @contextmanager
     def time_func(self, name: str):

@@ -98,9 +98,29 @@ class MultiRegionManager:
         if n >= self.behaviors.multi_region_batch_limit:
             self._loop.poke()
 
+    def _fault_tick(self) -> bool:
+        """Chaos hook (ISSUE 7 satellite: multiregion reconciliation
+        had zero fault coverage): True aborts this tick BEFORE the
+        queues are popped, so an injected failure loses nothing — the
+        aggregates flush on the next clean tick (conservation holds,
+        asserted by the chaos cell)."""
+        f = getattr(self.instance, "faults", None)
+        if f is None or not f.armed:
+            return False
+        try:
+            f.fire("mr_sync")
+        except Exception as e:  # noqa: BLE001 - incl. FaultInjected
+            msg = f"multi-region sync tick: {e!r}"
+            log.warning(msg)
+            self._record([msg])
+            return True
+        return False
+
     def _run_async_reqs(self) -> None:
         """Push aggregated hits to each other region's key owner.
         reference: mutliregion.go › runAsyncReqs."""
+        if self._fault_tick():
+            return
         with self._mu:
             hits, self._hits = self._hits, {}
             hits_raw, self._hits_raw = self._hits_raw, {}

@@ -58,6 +58,26 @@ def test_section_child_backend_mismatch_guard(tmp_path):
     assert "silent fallback" in rows["error"]
 
 
+def test_mesh_global_section_child_writes_row(tmp_path):
+    """The 12_mesh_global row (ISSUE 7) through the driver's real child
+    protocol on an 8-device CPU mesh: the A/B must be bit-identical,
+    conservation exact, staleness within the reconcile interval, and
+    zero gRPC peer RPCs — the acceptance columns, pinned tier-1."""
+    rows = _run_section(
+        "mesh", tmp_path, timeout=600,
+        extra_env={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=8"})
+    r = rows["12_mesh_global"]
+    assert r["n_shards"] == 8
+    assert r["decisions_per_s"] > 0
+    assert r["grpc_decisions_per_s"] > 0
+    assert r["ab_identical"] is True
+    assert r["conservation_exact"] is True
+    assert r["staleness_within_interval"] is True
+    assert r["zero_peer_rpcs"] is True
+    assert r["reconcile_generations"] >= 1
+
+
 def test_section_registry_covers_baseline_rows():
     """Every BASELINE row key the orchestrator may need to error-fill
     is declared by exactly one section."""
@@ -70,7 +90,7 @@ def test_section_registry_covers_baseline_rows():
                 "4_global_sharded", "5_gregorian_churn",
                 "6_service_path", "7_hot_psum", "8_peer_path",
                 "9_clustered_service", "10_reuseport_group",
-                "11_pallas_serving"]:
+                "11_pallas_serving", "12_mesh_global"]:
         assert row in declared, row
     for name in bench._SECTION_ORDER:
         assert name in bench._SECTIONS

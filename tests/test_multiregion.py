@@ -90,3 +90,61 @@ def test_no_ping_pong(regions):
     time.sleep(0.5)
     assert _remaining_in_region(regions, 2, key) == 95
     assert _remaining_in_region(regions, 0, key) == 95
+
+
+def test_mr_sync_fault_conservation(regions):
+    """ISSUE 7 satellite: multiregion reconciliation fault coverage.
+    An armed `mr_sync` fault aborts the flush tick BEFORE the queues
+    pop, so the aggregated hits survive intact; once the fault clears,
+    the other region converges with the EXACT total — cross-region
+    conservation holds through the chaos window."""
+    # a key whose dc-east owner IS daemon 0 (the MR queue lives on the
+    # region owner, and that is whose faults we arm)
+    key = None
+    for i in range(200):
+        cand = f"account:77{i}"
+        if regions.owner_daemon_of(f"mr_test_{cand}") \
+                is regions.daemon_at(0):
+            key = cand
+            break
+    assert key is not None
+    inst = regions.instance_at(0)  # dc-east owner of `key`
+    # arm BEFORE queueing: every flush tick aborts pre-pop, so the
+    # aggregate cannot leak out on a clean tick racing the assertions
+    inst.faults.arm("mr_sync:error", seed=5)
+    try:
+        with Client(regions.grpc_address(0)) as c:
+            for _ in range(4):
+                r = c.check(req(key, hits=3))
+                assert r.error == ""
+        mr = inst._ensure_mr_manager()
+        fired0 = sum(p["fired"]
+                     for p in inst.faults.describe()["points"])
+        mr.poke()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if sum(p["fired"]
+                   for p in inst.faults.describe()["points"]) > fired0:
+                break
+            time.sleep(0.02)
+        assert sum(p["fired"]
+                   for p in inst.faults.describe()["points"]) > fired0
+        # aborted before the pop: the aggregate is still queued whole
+        with mr._mu:
+            accs = {k: acc for k, (_r, acc, _s) in mr._hits.items()}
+            accs.update({k: acc for k, (_t, acc, _s)
+                         in mr._hits_raw.items()})
+        assert sum(accs.values()) == 12, accs
+    finally:
+        inst.faults.clear()
+    # conservation: after the fault clears, dc-west converges to the
+    # exact total (4 × 3 hits) within the sync window
+    deadline = time.time() + 8
+    west = None
+    while time.time() < deadline:
+        west = _remaining_in_region(regions, 2, key)
+        if west == 88:
+            break
+        inst.mr_manager.poke()
+        time.sleep(0.05)
+    assert west == 88, f"west never converged exactly (remaining={west})"

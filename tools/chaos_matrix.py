@@ -105,12 +105,21 @@ class _Ctx:
         cfg = Config(behaviors=BehaviorConfig())
         cfg.loader = MockLoader()
         self.solo = V1Instance(cfg)
+        # solo mesh-mode instance (ISSUE 7): the collective reconcile
+        # faultpoints (global_psum / global_accum_swap) live on its
+        # GlobalManager tick
+        self.mesh = V1Instance(Config(
+            global_mode="mesh",
+            behaviors=BehaviorConfig(global_sync_wait_ms=50)))
 
     def close(self):
         try:
-            self.solo.close()
+            self.mesh.close()
         finally:
-            self.c.stop()
+            try:
+                self.solo.close()
+            finally:
+                self.c.stop()
 
 
 def _classify_rows(data: bytes) -> str:
@@ -195,6 +204,77 @@ def _drive_global(loop_attr: str):
     return drive
 
 
+def _drive_mesh(ctx: _Ctx) -> str:
+    """global_psum / global_accum_swap (ISSUE 7): GLOBAL traffic on the
+    solo mesh-mode instance, then force the reconcile tick.  An error
+    at either point aborts the tick with the accumulators intact
+    (swap-back); ``_mesh_probe`` re-verifies exact conservation after
+    the harness clears the fault."""
+    from gubernator_tpu.types import Behavior
+
+    inst = ctx.mesh
+    inst.get_rate_limits_wire(
+        _one("meshkey", behavior=int(Behavior.GLOBAL)), now_ms=NOW0)
+    fired0 = sum(p["fired"] for p in inst.faults.describe()["points"])
+    inst.global_manager.poke()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if sum(p["fired"]
+               for p in inst.faults.describe()["points"]) > fired0:
+            return "aborted_tick"
+        time.sleep(0.02)
+    return "served"  # tick ran without reaching the point
+
+
+def _mesh_probe(ctx: _Ctx) -> bool:
+    """Post-clear recovery for the mesh cells: one clean reconcile
+    tick must fold EVERY accumulated hit — folded == injected is the
+    conservation oracle the collective path promises even after an
+    injected swap/psum failure (nothing stranded, nothing doubled)."""
+    inst = ctx.mesh
+    try:
+        inst._mesh_reconcile_tick()
+        mge = inst._meshglobal
+        if mge is None:
+            return False
+        mge.drain()
+        return mge.folded_hits == mge.injected_hits
+    except Exception:  # noqa: BLE001 - a raising probe is a failure
+        return False
+
+
+def _drive_mr(ctx: _Ctx) -> str:
+    """mr_sync (ISSUE 7 satellite): multiregion reconciliation had
+    zero fault coverage.  Queue MR hits, force the tick; an ERROR
+    fault aborts BEFORE the queues pop, so the aggregate must survive
+    intact (the conservation assertion) — a DELAY fault lets the tick
+    proceed and consume the queue normally."""
+    from gubernator_tpu.types import Behavior, RateLimitRequest
+
+    inst = ctx.i0
+    mr = inst._ensure_mr_manager()
+    mr.queue_hits(RateLimitRequest(
+        name="chaos", unique_key="mrkey", hits=7, limit=10 ** 6,
+        duration=DAY, behavior=Behavior.MULTI_REGION))
+    fired0 = sum(p["fired"] for p in inst.faults.describe()["points"])
+    mr.poke()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if sum(p["fired"]
+               for p in inst.faults.describe()["points"]) > fired0:
+            time.sleep(0.1)  # let the tick finish either way
+            with mr._mu:
+                kept = {k: acc for k, (_r, acc, _s) in mr._hits.items()}
+            if not kept:
+                return "served"  # delay mode: flushed normally
+            if kept.get("chaos_mrkey") != 7:
+                # popped-but-partial would be a conservation loss
+                return f"unexpected:queue_lost {kept}"
+            return "aborted_tick"
+        time.sleep(0.02)
+    return "served"
+
+
 def _drive_snapshot(ctx: _Ctx) -> str:
     ctx.solo.get_rate_limits_wire(_one("snapkey"), now_ms=NOW0)
     ctx.solo._save_to_loader()
@@ -242,6 +322,14 @@ MATRIX = {
     "wire_ingest": (_drive_ingest, "cluster"),
     "global_broadcast": (_drive_global("_bcast_loop"), "cluster"),
     "global_hits": (_drive_global("_hits_loop"), "cluster"),
+    # mesh-GLOBAL collective reconcile (ISSUE 7): armed on the solo
+    # mesh-mode instance; each cell re-verifies exact conservation
+    # after the fault clears
+    "global_psum": (_drive_mesh, "mesh"),
+    "global_accum_swap": (_drive_mesh, "mesh"),
+    # multiregion reconciliation (ISSUE 7 satellite: ROADMAP flagged
+    # zero fault coverage) — abort-before-pop keeps the queue intact
+    "mr_sync": (_drive_mr, "cluster"),
     "snapshot": (_drive_snapshot, "solo"),
     "restore": (_drive_restore, "solo"),
 }
@@ -260,7 +348,8 @@ def run_matrix(points=None, verbose=False) -> dict:
         for point, (driver, where) in MATRIX.items():
             if points and point not in points:
                 continue
-            inst = ctx.solo if where == "solo" else ctx.i0
+            inst = {"solo": ctx.solo, "mesh": ctx.mesh}.get(where,
+                                                            ctx.i0)
             for mode in MODES:
                 spec = (f"{point}:delay:5ms" if mode == "delay"
                         else f"{point}:error")
@@ -278,7 +367,12 @@ def run_matrix(points=None, verbose=False) -> dict:
                 inst.faults.clear()
                 if fired == 0:
                     outcome = "not_reached"
-                recovered = _probe(ctx) if where == "cluster" else True
+                if where == "cluster":
+                    recovered = _probe(ctx)
+                elif where == "mesh":
+                    recovered = _mesh_probe(ctx)
+                else:
+                    recovered = True
                 ok = (outcome != "hung"
                       and not outcome.startswith("unexpected")
                       and recovered)

@@ -2,8 +2,8 @@
 
 PY ?= python
 
-.PHONY: test proto bench chaos tpu-session b-sweep daemon cluster lint \
-        native tsan asan racer check clean
+.PHONY: test proto bench bench-pallas chaos tpu-session b-sweep daemon \
+        cluster lint native tsan asan racer check clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -54,6 +54,12 @@ proto:
 
 bench:
 	$(PY) bench.py
+
+# the fused-serving A/B row (11_pallas_serving) standalone: fused
+# engine vs classic XLA on identical seeded wire traffic, with the
+# PhaseLedger phase_deleted evidence (ISSUE 8)
+bench-pallas:
+	GUBER_BENCH_SECTION=pallas $(PY) bench.py
 
 # one-shot on-chip validation battery (run when a TPU is reachable)
 tpu-session:

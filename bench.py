@@ -1557,67 +1557,128 @@ def _sec_cfg5():
 
 
 def _sec_pallas():
-    """step_impl=pallas as the SERVING mode (VERDICT r4 item 2a): the
-    full V1Instance wire path — bytes → dispatcher → Mosaic-kernel
-    step → bytes — over PallasServingEngine at the large-CAP shape the
-    mode exists for (the CAP ≥ 2^22 scatter-pathology escalation
-    tier).  On CPU the kernel runs in interpret mode, orders slower
-    than XLA by construction, so the fallback shape is tiny and the
-    row says so; only the TPU row is a real serving measurement."""
+    """GUBER_ENGINE=pallas as THE serving engine (ISSUE 8): the full
+    V1Instance wire path — bytes → dispatcher → ONE fused device
+    program per wave (decision kernel + on-device heavy-hitter tap) →
+    bytes — A/B'd against the classic XLA engine on IDENTICAL seeded
+    traffic.  On TPU the fused engine embeds the Mosaic bucket kernel
+    at the large-CAP shape the mode exists for; on CPU it embeds the
+    COMPILED small-shape XLA kernel (XlaFusedEngine) — the old
+    interpret-mode toy row measured nothing and is gone (its number is
+    recorded under pre_pr).  The row carries the A/B bit-identity, the
+    fused/xla throughput ratio, and PhaseLedger evidence that the pack
+    phase collapsed into `device` (phase_deleted)."""
     import jax
 
     from gubernator_tpu.config import Config
     from gubernator_tpu.instance import V1Instance
     from gubernator_tpu.parallel import make_mesh
-    from gubernator_tpu.parallel.pallas_engine import PallasServingEngine
+    from gubernator_tpu.parallel.pallas_engine import (
+        PallasServingEngine, XlaFusedEngine)
 
     cpu = jax.default_backend() == "cpu"
-    cap = 1 << 12 if cpu else 1 << 24  # 2 GiB of rows on-chip
-    reps = 4 if cpu else 20
-    rng = np.random.default_rng(7)
-    row = {"capacity": cap, "cpu_interpret_reduced": cpu, "batch": 1000}
-    # env GUBER_STEP_IMPL would override Config and silently measure
-    # the wrong engine — force it for this row, restore after
-    prev_impl = os.environ.get("GUBER_STEP_IMPL")
-    os.environ["GUBER_STEP_IMPL"] = "pallas"
-    try:
-        inst = V1Instance(Config(cache_size=cap, sweep_interval_ms=0,
-                                 step_impl="pallas"),
-                          mesh=make_mesh(n=1))
-    finally:
-        if prev_impl is None:
-            os.environ.pop("GUBER_STEP_IMPL", None)
-        else:
-            os.environ["GUBER_STEP_IMPL"] = prev_impl
-    try:
-        assert isinstance(inst.engine, PallasServingEngine)
-        datas = _serialize_reqs(_make_reqs(rng))
-        if cpu:
-            datas = datas[:2]
-        inst.get_rate_limits_wire(datas[0], now_ms=NOW0)  # compile
-        t0 = time.perf_counter()
-        for r in range(reps):
-            inst.get_rate_limits_wire(datas[r % len(datas)],
-                                      now_ms=NOW0 + 1 + r)
-        row["wire_lane_decisions_per_s"] = round(
-            reps * 1000 / (time.perf_counter() - t0))
-        lat = []
-        for r in range(8 if cpu else 60):
+    cap = 1 << 14 if cpu else 1 << 24  # 2 GiB of rows on-chip
+    reps = 8 if FAST else (16 if cpu else 20)
+    row = {"capacity": cap, "batch": 1000, "cpu_compiled": cpu,
+           "engine": "xla_fused" if cpu else "pallas_fused",
+           "compiled_kernels": True,
+           # the row this one replaces: interpret-mode kernel at a toy
+           # shape, self-described as measuring nothing (BENCH_r05)
+           "pre_pr": {"wire_lane_decisions_per_s": 80411,
+                      "mode": "interpret toy (BENCH_r05; 'measures "
+                              "nothing')"}}
+    datas = _serialize_reqs(_make_reqs(np.random.default_rng(7)))
+
+    def drive(engine_sel):
+        # env GUBER_STEP_IMPL / GUBER_ENGINE would override Config and
+        # silently measure the wrong engine — pin both for this build
+        prev_e = os.environ.get("GUBER_ENGINE")
+        prev_i = os.environ.get("GUBER_STEP_IMPL")
+        os.environ["GUBER_ENGINE"] = engine_sel
+        os.environ.pop("GUBER_STEP_IMPL", None)
+        try:
+            inst = V1Instance(Config(cache_size=cap,
+                                     sweep_interval_ms=0,
+                                     engine=engine_sel),
+                              mesh=make_mesh(n=1))
+        finally:
+            for k, v in (("GUBER_ENGINE", prev_e),
+                         ("GUBER_STEP_IMPL", prev_i)):
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        try:
+            inst.get_rate_limits_wire(datas[0], now_ms=NOW0)  # compile
+            outs = []
             t0 = time.perf_counter()
-            inst.get_rate_limits_wire(datas[r % len(datas)],
-                                      now_ms=NOW0 + 40 + r)
-            lat.append((time.perf_counter() - t0) * 1e3)
-        row["svc_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
-        row["svc_p99_ms"] = round(float(np.percentile(lat, 99)), 3)
-        row["occupancy"] = int(inst.engine.occupancy())
-        row["telemetry"] = _telemetry_rows(inst)
-    finally:
-        inst.close()
+            for r in range(reps):
+                outs.append(inst.get_rate_limits_wire(
+                    datas[r % len(datas)], now_ms=NOW0 + 1 + r))
+            dps = reps * 1000 / (time.perf_counter() - t0)
+            lat = []
+            for r in range(8 if cpu else 60):
+                t0 = time.perf_counter()
+                inst.get_rate_limits_wire(datas[r % len(datas)],
+                                          now_ms=NOW0 + 40 + r)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            ana = inst.dispatcher.analytics
+            phases = (ana.phases.snapshot() if ana is not None else {})
+            # the exact wave-time partition is the proof of phase
+            # deletion: sum(segments) == wave duration on every wave
+            drift = 0.0
+            for ev in inst.recorder.events(limit=256):
+                if ev.get("kind") == "wave_completed" \
+                        and ev.get("phases"):
+                    drift = max(drift, abs(
+                        sum(ev["phases"].values())
+                        - ev["duration_ms"]))
+            return {"dps": dps, "outs": outs, "phases": phases,
+                    "lat": lat, "drift_ms": drift,
+                    "engine_cls": type(inst.engine).__name__,
+                    "fused_waves": getattr(inst.engine,
+                                           "fused_wave_count", 0),
+                    "occupancy": int(inst.engine.occupancy()),
+                    "telemetry": _telemetry_rows(inst)}
+        finally:
+            inst.close()
+
+    fused = drive("pallas")
+    xla = drive("xla")
+    want = (XlaFusedEngine if cpu else PallasServingEngine).__name__
+    assert fused["engine_cls"] == want, fused["engine_cls"]
+    pmeans = {k: {p: v["p50_ms"] for p, v in d["phases"].items()
+                  if p in ("pack", "device", "resolve")}
+              for k, d in (("fused", fused), ("xla", xla))}
+    row.update({
+        "wire_lane_decisions_per_s": round(fused["dps"]),
+        "xla_wire_decisions_per_s": round(xla["dps"]),
+        "fused_vs_xla": round(fused["dps"] / max(xla["dps"], 1e-9), 3),
+        "ab_identical": fused["outs"] == xla["outs"],
+        "fused_waves": fused["fused_waves"],
+        "svc_p50_ms": round(float(np.percentile(fused["lat"], 50)), 3),
+        "svc_p99_ms": round(float(np.percentile(fused["lat"], 99)), 3),
+        "occupancy": fused["occupancy"],
+        "telemetry": fused["telemetry"],
+        # PhaseLedger evidence: the classic engine's waves carry a pack
+        # segment; fused waves don't — `device` absorbed it, and the
+        # per-wave partition stays exact (drift is float rounding)
+        "phase_deleted": {
+            "deleted_phase": "pack",
+            "pack_absent_in_fused": "pack" not in fused["phases"],
+            "pack_present_in_xla": "pack" in xla["phases"],
+            "phase_p50_ms": pmeans,
+            "partition_max_drift_ms": round(
+                max(fused["drift_ms"], xla["drift_ms"]), 3)},
+    })
     if cpu:
         row["context"] = (
-            "CPU fallback runs the kernel in INTERPRET mode at a toy "
-            "shape — proves the serving path end-to-end, measures "
-            "nothing; the TPU row is the large-CAP serving claim")
+            "CPU row serves from the COMPILED small-shape XLA fused "
+            "flavor (GUBER_ENGINE=pallas off-TPU): decisions "
+            "bit-identical to the classic engine by construction, so "
+            "the A/B prices exactly what fusion deletes (host tap "
+            "copies + the pack mark). The Mosaic bucket kernel at "
+            "large CAP is the TPU row")
     return {"11_pallas_serving": row}
 
 

@@ -475,6 +475,37 @@ class KeyAnalytics:
         return self._put(("reqs", list(reqs), list(resps),
                           int(self._clock() * 1000)))
 
+    def tap_device(self, tap) -> bool:
+        """Fused-engine wave tap (ISSUE 8): ``tap`` is the [4, B] int64
+        device array the fused serving program emitted alongside its
+        decisions — rows (khash bit-viewed, hits, over, served).  NO
+        host copy happens here: the jax array is a future; the worker
+        thread's np.asarray is where the device→host transfer (and any
+        blocking on the wave) lands, strictly off the serving path.
+        Returns False when the queue was full (wave dropped)."""
+        return self._put(("dev", tap, int(self._clock() * 1000)))
+
+    @staticmethod
+    def _dev_to_cols(item):
+        """Materialize a device tap on the WORKER thread → a "cols"
+        item (padding / invalid / table-full rows gated out by the
+        kernel-emitted ``served`` row).  None when empty or the array
+        failed to materialize (a dead device must not kill the
+        worker)."""
+        try:
+            arr = np.asarray(item[1])
+            served = arr[3] != 0
+            if not served.any():
+                return None
+            return ("cols", arr[0][served].view(np.uint64),
+                    arr[1][served], arr[2][served] != 0, int(item[2]))
+        except Exception:  # pragma: no cover - analytics only
+            import logging
+
+            logging.getLogger("gubernator_tpu.analytics").exception(
+                "device tap materialize")
+            return None
+
     def _put(self, item) -> bool:
         try:
             self._q.put_nowait(item)
@@ -517,6 +548,12 @@ class KeyAnalytics:
                     item.done.set()
                 elif item[0] == "cols":
                     cols.append(item)
+                elif item[0] == "dev":
+                    # fused-engine device tap: the device→host copy
+                    # happens HERE, on the worker
+                    c = self._dev_to_cols(item)
+                    if c is not None:
+                        cols.append(c)
                 else:
                     # object-lane (named) tap: fold queued columns
                     # first so wave order is preserved

@@ -60,6 +60,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_DNS_FQDN": "DNS discovery: FQDN to resolve for peers",
     "GUBER_DNS_RESOLVE_INTERVAL": "DNS discovery: re-resolve interval (duration)",
     "GUBER_DRAIN_GRACE": "graceful-shutdown drain budget (duration); bounds every drain join",
+    "GUBER_ENGINE": "serving engine: auto (default; fused pallas on TPU, classic xla elsewhere), pallas (fused everywhere — compiled XLA flavor off-TPU), xla/sharded (classic)",
     "GUBER_ETCD_ENDPOINTS": "etcd discovery: comma-separated endpoints",
     "GUBER_ETCD_PREFIX": "etcd discovery: key prefix for peer registration",
     "GUBER_EXTRAS_SMOKE": "tools/tpu_session: run the extras smoke block",
@@ -89,6 +90,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_MULTI_REGION_TIMEOUT": "cross-region flush RPC timeout (duration)",
     "GUBER_NATIVE_SAN": "setup_native.py: build _native under tsan/asan (make tsan / make asan)",
     "GUBER_PALLAS_PROBE_OUT": "tools/pallas_probe: checkpoint JSON path",
+    "GUBER_PALLAS_TILE": "Mosaic kernel block shape: requests per grid step (8-4096, default 128)",
     "GUBER_PALLAS_SWEEP": "1/0 force the fused Pallas sweep on/off (default: TPU only)",
     "GUBER_PEERS": "static peer list (host:port,... ) for static discovery",
     "GUBER_PEERS_FILE": "file-based discovery: path to the peer list",
@@ -261,6 +263,15 @@ class Config:
     #: auto-grow — parallel/pallas_engine.py).  GUBER_STEP_IMPL
     #: overrides.
     step_impl: str = ""
+    #: Serving-engine selector (ISSUE 8; GUBER_ENGINE overrides):
+    #: "auto" (default) = the fused Pallas engine on TPU, the classic
+    #: XLA sharded engine elsewhere; "pallas" = fused serving
+    #: everywhere (off-TPU: the compiled XLA fused flavor — one fused
+    #: program per wave with on-device tap + mesh scatter, small-shape
+    #: wave buckets); "xla"/"sharded" = the classic engine explicitly.
+    #: Construction failures fall back LOUDLY to the classic engine
+    #: (engine_fallback event) — availability beats mode fidelity.
+    engine: str = ""
     #: GLOBAL reconcile backend (ISSUE 7): "" / "grpc" keeps the
     #: reference's hit-queue + broadcast machinery; "mesh" serves
     #: pod-local GLOBAL keys from the mesh-resident replica tier
@@ -365,6 +376,8 @@ class DaemonConfig:
     #: Decision-step implementation ("" → "xla"; "pallas" = the Mosaic
     #: kernel serving mode — Config.step_impl).
     step_impl: str = ""
+    #: Serving-engine selector ("" → "auto" — Config.engine).
+    engine: str = ""
     #: GLOBAL reconcile backend ("" → "grpc"; "mesh" = pod-local
     #: collective fold — Config.global_mode).
     global_mode: str = ""
@@ -375,6 +388,7 @@ class DaemonConfig:
             cache_autogrow_max=self.cache_autogrow_max,
             batch_rows=self.batch_rows,
             step_impl=self.step_impl,
+            engine=self.engine,
             global_mode=self.global_mode,
             handover_on_reshard=self.handover_on_reshard,
             behaviors=self.behaviors,
@@ -458,6 +472,7 @@ def setup_daemon_config(conf_file: str = "",
     d.log_level = src.get("GUBER_LOG_LEVEL", d.log_level)
     d.snapshot_path = src.get("GUBER_SNAPSHOT_PATH", d.snapshot_path)
     d.step_impl = src.get("GUBER_STEP_IMPL", d.step_impl)
+    d.engine = src.get("GUBER_ENGINE", d.engine)
     d.global_mode = src.get("GUBER_GLOBAL_MODE", d.global_mode)
 
     b = d.behaviors

@@ -118,17 +118,34 @@ class _Job:
 
 class _PackedJob:
     """Columnar job (C++ wire-ingest lane): a RequestBatch of numpy
-    columns + key hashes instead of RateLimitRequest objects."""
+    columns + key hashes instead of RateLimitRequest objects.
+    ``mslot`` (ISSUE 8): optional per-request mesh-GLOBAL replica slot
+    column (-1 = sharded row) — rides the job so a fused engine can
+    serve both lanes in ONE launch."""
 
-    __slots__ = ("batch", "khash", "now_ms", "future", "t_enq", "trace")
+    __slots__ = ("batch", "khash", "now_ms", "future", "t_enq", "trace",
+                 "mslot")
 
-    def __init__(self, batch, khash, now_ms):
+    def __init__(self, batch, khash, now_ms, mslot=None):
         self.batch = batch
         self.khash = khash
         self.now_ms = now_ms
+        self.mslot = mslot
         self.future: Future = Future()
         self.t_enq: Optional[float] = None
         self.trace: Optional[str] = None
+
+
+def _concat_mslot(jobs):
+    """Concat the wave's per-job mesh-slot columns (None when no job
+    carries one; jobs without a column fill -1 = sharded lane)."""
+    if all(getattr(j, "mslot", None) is None for j in jobs):
+        return None
+    import numpy as np
+
+    return np.concatenate([
+        j.mslot if getattr(j, "mslot", None) is not None
+        else np.full(_job_len(j), -1, np.int32) for j in jobs])
 
 
 class Dispatcher:
@@ -265,6 +282,14 @@ class Dispatcher:
         #: fast path to a pipeline that can't exist)
         self._pipelined = (self._want_pipeline()
                            and hasattr(engine, "launch_packed"))
+        # fused-engine capabilities (ISSUE 8): a fused engine's wave IS
+        # one device program, so the pack mark collapses into the
+        # `device` phase (the PhaseLedger partition stays exact — the
+        # tail segment is still `resolve`), and the engine emits the
+        # heavy-hitter tap columns on device at launch, so the
+        # dispatcher's host-side column copies are skipped.
+        self._fused_phases = getattr(engine, "fused_serving", False)
+        self._fused_tap = getattr(engine, "fused_tap", False)
         if self.metrics is not None:
             self.metrics.pipeline_depth.set(
                 self.pipeline_depth if self._pipelined else 0)
@@ -372,7 +397,7 @@ class Dispatcher:
         try:
             wid = self._wave_begin(kind, nreq=nreq)
             try:
-                self._wave_mark(wid, "pack")
+                self._mark_pack(wid)
                 with self._engine_lock:
                     self._fault("device_step")
                     out = fn()
@@ -399,7 +424,7 @@ class Dispatcher:
             try:
                 wid = self._wave_begin("inline", nreq=len(reqs))
                 try:
-                    self._wave_mark(wid, "pack")
+                    self._mark_pack(wid)
                     with self._engine_lock:
                         self._fault("device_step")
                         out = self.engine.check_batch(list(reqs), now_ms)
@@ -419,16 +444,20 @@ class Dispatcher:
         except FuturesTimeout as e:
             raise self._result_timeout(e) from e
 
-    def check_packed(self, batch, khash, now_ms: int) -> tuple:
+    def check_packed(self, batch, khash, now_ms: int,
+                     mslot=None) -> tuple:
         """Columnar submit (see engine.check_packed); coalesces with
         other packed callers by column concatenation.  Idle → inline
         (a lone packed job's wave is exactly engine.check_packed).
         Returns the classic 5-tuple of per-request columns; the
         slicing out of the wave's shared result columns happens HERE,
-        in the caller's thread (see ResultView)."""
-        return self.check_packed_view(batch, khash, now_ms).sliced()
+        in the caller's thread (see ResultView).  ``mslot`` (ISSUE 8):
+        per-request mesh-GLOBAL slot column for fused engines."""
+        return self.check_packed_view(batch, khash, now_ms,
+                                      mslot=mslot).sliced()
 
-    def check_packed_view(self, batch, khash, now_ms: int) -> ResultView:
+    def check_packed_view(self, batch, khash, now_ms: int,
+                          mslot=None) -> ResultView:
         """``check_packed`` returning the zero-copy ResultView: row
         bounds into the wave's shared downloaded result columns.  The
         wire lanes serialize straight from the view (ops/_native.cpp ›
@@ -438,11 +467,11 @@ class Dispatcher:
             try:
                 wid = self._wave_begin("inline_packed", nreq=len(khash))
                 try:
-                    self._wave_mark(wid, "pack")
+                    self._mark_pack(wid)
                     with self._engine_lock:
                         self._fault("device_step")
-                        out = self.engine.check_packed(batch, khash,
-                                                       now_ms)
+                        out = self._engine_check_packed(batch, khash,
+                                                        now_ms, mslot)
                     self._wave_mark(wid, "device")
                 except Exception as e:  # noqa: BLE001 - recorded, re-raised
                     self._wave_end(wid, error=e)
@@ -452,7 +481,7 @@ class Dispatcher:
                 return ResultView(out, 0, len(khash))
             finally:
                 self._inline_mu.release()
-        job = _PackedJob(batch, khash, now_ms)
+        job = _PackedJob(batch, khash, now_ms, mslot=mslot)
         self._submit(job)
         try:
             return job.future.result(timeout=self.RESULT_TIMEOUT_S)
@@ -635,6 +664,26 @@ class Dispatcher:
             if info is not None:
                 info["marks"].append((name, t))
 
+    def _mark_pack(self, wid: int) -> None:
+        """Stamp the end of the pack segment — SUPPRESSED for fused
+        engines (ISSUE 8): their wave is one device program, so the
+        partition collapses to {device, resolve} and the `device`
+        phase absorbs what fusion deletes.  The exact wave-time
+        partition (sum of segments == wave duration) holds either way
+        — that partition IS the proof of which phase time fusion
+        removed, surfaced by the bench A/B's phase_deleted evidence."""
+        if not self._fused_phases:
+            self._wave_mark(wid, "pack")
+
+    def _engine_check_packed(self, batch, khash, now_ms: int, mslot):
+        """engine.check_packed with the mesh-slot column only when one
+        exists: non-fused engines (oracle, store-backed) keep their
+        3-arg signature."""
+        if mslot is None:
+            return self.engine.check_packed(batch, khash, now_ms)
+        return self.engine.check_packed(batch, khash, now_ms,
+                                        mslot=mslot)
+
     def _obs_phase(self, phase: str, seconds: float) -> None:
         """One phase sample → histogram (+ the analytics ledger when
         attached; KeyAnalytics.observe_phase already feeds the same
@@ -651,7 +700,11 @@ class Dispatcher:
 
     def _tap_packed(self, khash, hits, status) -> None:
         """Post-wave columnar tap (None-guarded, never raises into the
-        serving path)."""
+        serving path).  Fused engines already emitted the tap columns
+        ON DEVICE inside the wave's program — the host-side copies
+        here are exactly what the fusion deleted, so skip them."""
+        if self._fused_tap:
+            return
         ana = self.analytics
         if ana is not None:
             try:
@@ -1050,13 +1103,18 @@ class Dispatcher:
             else:
                 batch, khash = _concat_columns(
                     [(j.batch, j.khash) for j in jobs])
+            mslot = _concat_mslot(jobs)
             now = max(j.now_ms for j in jobs)
             with self._engine_lock:
                 self._fault("device_step")
-                token = self.engine.launch_packed(batch, khash, now)
+                token = (self.engine.launch_packed(batch, khash, now)
+                         if mslot is None
+                         else self.engine.launch_packed(batch, khash,
+                                                        now,
+                                                        mslot=mslot))
             # the launch's host-side routing/fill IS pack work; device
             # time runs from here until sync_packed returns
-            self._wave_mark(wid, "pack")
+            self._mark_pack(wid)
             return (jobs, token, wid, batch, khash)
         except Exception as e:  # noqa: BLE001 - surfaced per-caller
             self._wave_end(wid, error=e)
@@ -1115,22 +1173,30 @@ class Dispatcher:
                                hash_request_keys,
                                responses_from_columns, wid) -> tuple:
         parts = []  # (job, batch, khash, errs or None)
+        mparts = []
         for j in wave:
             if isinstance(j, _PackedJob):
                 parts.append((j, j.batch, j.khash, None))
+                mparts.append(j.mslot if j.mslot is not None
+                              else np.full(len(j.khash), -1, np.int32))
             else:
                 kh = hash_request_keys([r.name for r in j.reqs],
                                        [r.unique_key for r in j.reqs])
                 b, errs = pack_requests(j.reqs, j.now_ms,
                                         size=len(j.reqs), key_hashes=kh)
                 parts.append((j, b, kh, errs))
+                mparts.append(np.full(len(kh), -1, np.int32))
         batch, khash = _concat_columns([(p[1], p[2]) for p in parts])
+        mslot = (np.concatenate(mparts)
+                 if any(isinstance(j, _PackedJob)
+                        and j.mslot is not None for j in wave)
+                 else None)
         now = max(j.now_ms for j in wave)
-        self._wave_mark(wid, "pack")
+        self._mark_pack(wid)
         with self._engine_lock:
             self._fault("device_step")
-            st, lim, rem, rst, full = self.engine.check_packed(
-                batch, khash, now)
+            st, lim, rem, rst, full = self._engine_check_packed(
+                batch, khash, now, mslot)
         self._wave_mark(wid, "device")
         self._fault("dispatch_splice")
         a = 0
@@ -1158,7 +1224,7 @@ class Dispatcher:
         wid = self._wave_begin("list", jobs)
         try:
             self._fault("dispatch_launch")
-            self._wave_mark(wid, "pack")
+            self._mark_pack(wid)
             with self._engine_lock:
                 self._fault("device_step")
                 resps = self.engine.check_batch(merged, now)
@@ -1186,14 +1252,16 @@ class Dispatcher:
             else:
                 batch, khash = _concat_columns(
                     [(j.batch, j.khash) for j in jobs])
+            mslot = _concat_mslot(jobs)
             # scalar now only backstops sweeps/padding; requests use
             # their own now column.  max() keeps sweep time monotonic.
             now = max(j.now_ms for j in jobs)
             self._fault("dispatch_launch")
-            self._wave_mark(wid, "pack")
+            self._mark_pack(wid)
             with self._engine_lock:
                 self._fault("device_step")
-                cols = self.engine.check_packed(batch, khash, now)
+                cols = self._engine_check_packed(batch, khash, now,
+                                                 mslot)
             self._wave_mark(wid, "device")
             self._fault("dispatch_splice")
             a = 0

@@ -110,7 +110,7 @@ class V1Instance:
         if engine is None:
             # lazy: an injected engine (tests, alternative backends)
             # must not drag the sharded/jax stack in
-            from .parallel import ShardedEngine, make_mesh
+            from .parallel import make_mesh
 
             m = mesh if mesh is not None else make_mesh()
             n = m.shape["shard"]
@@ -125,31 +125,18 @@ class V1Instance:
                 raise ValueError(
                     f"unknown step_impl {step_impl!r} (want 'xla' or "
                     "'pallas')")
-            if step_impl == "pallas":
-                from .parallel.pallas_engine import PallasServingEngine
+            import jax as _jax
 
-                if config.cache_autogrow_max:
-                    # silently different capacity semantics would be a
-                    # trap: the xla engine grows to this bound, pallas
-                    # mode never grows (VERDICT r4 weak #4)
-                    log.warning(
-                        "step_impl=pallas ignores cache_autogrow_max="
-                        "%d: this mode has no on-device grow — size "
-                        "cache_size for peak keys up front (full "
-                        "8-slot buckets err as table_full; watch "
-                        "gubernator_pallas_bucket_saturation)",
-                        config.cache_autogrow_max)
-                engine = PallasServingEngine(
-                    m, capacity_per_shard=cap_local,
-                    batch_per_shard=config.batch_rows)
-            else:
-                from .parallel.sharded import autogrow_limit_per_shard
+            from .parallel.pallas_engine import resolve_engine_kind
 
-                engine = ShardedEngine(
-                    m, capacity_per_shard=cap_local,
-                    batch_per_shard=config.batch_rows,
-                    auto_grow_limit=autogrow_limit_per_shard(
-                        config.cache_autogrow_max, n, cap_local))
+            # GUBER_ENGINE (ISSUE 8): auto → fused pallas on TPU,
+            # classic xla elsewhere; explicit pallas → fused serving
+            # everywhere (compiled XLA flavor off-TPU); unknown raises
+            # inside resolve_engine_kind.
+            kind = resolve_engine_kind(
+                os.environ.get("GUBER_ENGINE") or config.engine or "",
+                step_impl, _jax.default_backend())
+            engine = self._build_engine(kind, m, n, cap_local, config)
         self.engine = engine
         self._engine_mu = threading.Lock()
         from .dispatcher import Dispatcher
@@ -172,6 +159,14 @@ class V1Instance:
                                      recorder=self.recorder,
                                      analytics=analytics,
                                      faults=self.faults)
+        # Fused-engine wiring (ISSUE 8): the fused serving program
+        # emits the heavy-hitter tap columns ON DEVICE — hand the
+        # analytics sink + metrics registry to the engine BEFORE any
+        # serving starts (single assignment, read-only afterwards).
+        if getattr(engine, "fused_tap", False):
+            if analytics is not None:
+                engine.tap_sink = analytics.tap_device
+            engine.metrics_ref = self.metrics
         # wave-buffer pool counters (hit/miss/leak) land on this
         # instance's registry; the pool lives engine-side (lease scope
         # is the engine's fill→launch window)
@@ -258,6 +253,65 @@ class V1Instance:
             # request, long enough (CPU: seconds) to idle-expire
             # short-duration buckets before their second request
             self._ensure_meshglobal().warmup()
+            # fused engines: also pre-compile the fused mesh program
+            # (decide + scatter in one launch) per wave bucket
+            if hasattr(self.engine, "warmup_mesh_fused"):
+                self.engine.warmup_mesh_fused()
+
+    def _build_engine(self, kind: str, m, n: int, cap_local: int,
+                      config: Config):
+        """Construct the resolved engine kind (ISSUE 8).  Fused kinds
+        selected through GUBER_ENGINE fall back LOUDLY to the classic
+        sharded engine on construction failure (engine_fallback event +
+        warning, decisions stay correct — availability beats mode
+        fidelity); the legacy explicit GUBER_STEP_IMPL=pallas raises as
+        it always has (the operator asked for that kernel engine
+        specifically, e.g. for a parity battery)."""
+        from .parallel.sharded import (ShardedEngine,
+                                       autogrow_limit_per_shard)
+
+        if kind in ("pallas-kernel", "pallas-fused", "xla-fused"):
+            try:
+                if kind == "xla-fused":
+                    from .parallel.pallas_engine import XlaFusedEngine
+
+                    return XlaFusedEngine(
+                        m, capacity_per_shard=cap_local,
+                        batch_per_shard=config.batch_rows,
+                        auto_grow_limit=autogrow_limit_per_shard(
+                            config.cache_autogrow_max, n, cap_local))
+                from .parallel.pallas_engine import PallasServingEngine
+
+                if config.cache_autogrow_max:
+                    # silently different capacity semantics would be a
+                    # trap: the xla engine grows to this bound, pallas
+                    # mode never grows (VERDICT r4 weak #4)
+                    log.warning(
+                        "pallas serving engine ignores "
+                        "cache_autogrow_max=%d: this mode has no "
+                        "on-device grow — size cache_size for peak "
+                        "keys up front (full 8-slot buckets err as "
+                        "table_full; watch "
+                        "gubernator_pallas_bucket_saturation)",
+                        config.cache_autogrow_max)
+                return PallasServingEngine(
+                    m, capacity_per_shard=cap_local,
+                    batch_per_shard=config.batch_rows)
+            except Exception as e:  # noqa: BLE001 - loud fallback below
+                if kind == "pallas-kernel":
+                    raise
+                log.warning(
+                    "fused engine %r unavailable (%s) — serving falls "
+                    "back to the classic sharded engine; decisions are "
+                    "identical, the fused-wave perf tier is OFF",
+                    kind, exc_text(e))
+                self.recorder.record("engine_fallback", wanted=kind,
+                                     error=exc_text(e))
+        return ShardedEngine(
+            m, capacity_per_shard=cap_local,
+            batch_per_shard=config.batch_rows,
+            auto_grow_limit=autogrow_limit_per_shard(
+                config.cache_autogrow_max, n, cap_local))
 
     # ---- persistence wiring (store.go › Loader/Store) ------------------
 
@@ -921,9 +975,12 @@ class V1Instance:
         ana = disp.analytics
         # the hits column lives in the LEASED matrices, which the next
         # wave reuses once check_prepacked releases them — snapshot it
-        # up front when the tap will need it (khash is lease-free)
+        # up front when the tap will need it (khash is lease-free).
+        # Fused engines (ISSUE 8) emit the tap ON DEVICE inside the
+        # wave — this host copy is exactly what the fusion deletes.
         hits_tap = (np.array(pre.lease.a64[1][:n])
-                    if ana is not None else None)
+                    if ana is not None and not disp._fused_tap
+                    else None)
         out = disp.run_inline_wave(
             "inline_wire", n, lambda: eng.check_prepacked(pre, now))
         if out is not disp._BUSY:
@@ -1363,6 +1420,47 @@ class V1Instance:
                 for (_p, ik, _s), good in zip(pins, ok):
                     if not good:  # probe window full → sharded path
                         mesh_mask = mesh_mask & (kh != np.uint64(ik))
+
+        # Fused single-launch path (ISSUE 8): a fused engine serves the
+        # WHOLE batch — mesh rows on the home replica + accumulator,
+        # sharded rows on the serving kernel — in ONE device program,
+        # deleting the second (meshglobal.check_columns) dispatch this
+        # runner otherwise pays per batch.  The mslot column carries
+        # each mesh row's pinned replica slot; -1 = sharded lane.
+        mslot_col = None
+        if getattr(self.engine, "mesh_bound", False) and mesh_mask.any():
+            mslot_col = np.full(n, -1, np.int32)
+            with mge._mu:
+                smap = dict(mge.slots)
+            for k in np.unique(kh[mesh_mask]):
+                s = smap.get(int(k))
+                if s is not None:
+                    mslot_col[mesh_mask & (kh == k)] = s
+                else:  # unpinned underneath us: sharded path is correct
+                    mesh_mask = mesh_mask & (kh != k)
+            if not (mslot_col >= 0).any():
+                mslot_col = None
+
+        def run_fused() -> bytes:
+            st, lim_o, rem, rst, full = self.dispatcher.check_packed(
+                batch, kh, now, mslot=mslot_col)
+            errors: Optional[list] = None
+            if full.any():
+                errors = [None] * n
+                for j in np.nonzero(full)[0]:
+                    errors[int(j)] = ("mesh-global row lost"
+                                      if mslot_col[int(j)] >= 0
+                                      else "rate limit table full")
+            if errs:
+                errors = errors or [None] * n
+                for i, emsg in errs.items():
+                    errors[i] = emsg
+            self.metrics.over_limit_counter.inc(int((st == 1).sum()))
+            return _wire_native.build_rate_limit_resps(
+                np.asarray(st, np.int64), lim_o, rem, rst, errors)
+
+        if mslot_col is not None:
+            return run_fused
 
         def run() -> bytes:
             status = np.zeros(n, np.int64)
@@ -2207,6 +2305,13 @@ class V1Instance:
                 self._meshglobal = MeshGlobalEngine(
                     self.engine.mesh, capacity=cap,
                     batch_per_chip=self.config.batch_rows)
+                # fused engines (ISSUE 8) fold the tier's home-replica
+                # decide + accumulator scatter into the serving wave's
+                # program — one launch per wave even in mesh mode.
+                # Routing still gates on _mesh_routable(): a degraded
+                # tier simply stops attaching mslot columns.
+                if hasattr(self.engine, "bind_mesh"):
+                    self.engine.bind_mesh(self._meshglobal)
             return self._meshglobal
 
     @staticmethod

@@ -97,6 +97,19 @@ class Metrics:
             "fraction of 8-slot buckets that are FULL (pallas serving "
             "mode; new keys hashing into a full bucket are unservable)",
             registry=r)
+        # Fused serving engine (ISSUE 8): GUBER_ENGINE=pallas serves
+        # each wave as ONE device program (decision kernel + on-device
+        # heavy-hitter tap + mesh-GLOBAL accumulator scatter when that
+        # tier is bound).  Zero for the classic engine.
+        self.pallas_fused_waves = Counter(
+            "gubernator_pallas_fused_waves",
+            "waves served by the fused serving program (device tap "
+            "emitted in-launch; no host-side tap copies)", registry=r)
+        self.pallas_mesh_fused_hits = Counter(
+            "gubernator_pallas_mesh_fused_hits",
+            "mesh-GLOBAL hits scatter-added by the fused serving "
+            "program (the injected side of the mesh conservation "
+            "ledger for fused waves)", registry=r)
         # Dispatcher wave telemetry (ISSUE 1): the wave/queue/compile
         # layer is the hot path and was previously unobservable — a
         # 250-305 s cold compile surfaced only as an empty TimeoutError

@@ -60,6 +60,7 @@ interpreter used by the parity tests.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple
 
@@ -75,7 +76,26 @@ from ..types import TD_BOUND, Behavior
 
 SLOTS = 8  # probe window = one bucket
 WORDS = 32  # i32 words per row (128 B — DMA-friendly, room to grow)
-TILE = 128  # requests per grid step
+TILE = 128  # requests per grid step (default; see pallas_tile())
+
+
+def pallas_tile() -> int:
+    """Requests per Mosaic grid step — the kernel's block-shape knob
+    (GUBER_PALLAS_TILE).  Bounded to [8, 4096]: the in-tile dedup map
+    is O(tile²) host work and the VMEM scratch is tile×1 KiB, so an
+    unbounded value would trade one launch for an unschedulable tile.
+    Malformed/out-of-range values keep the default (a perf knob must
+    never turn into a crash knob).  Resolved at engine/program BUILD
+    time — a live env flip does not retrace compiled programs."""
+    raw = os.environ.get("GUBER_PALLAS_TILE", "")
+    if raw:
+        try:
+            t = int(raw)
+            if 8 <= t <= 4096:
+                return t
+        except ValueError:
+            pass
+    return TILE
 
 #: value bound for i32 counter arithmetic (limit-change adjustment adds
 #: two limits before clipping, so 2^30 keeps every intermediate in i32)
@@ -315,14 +335,14 @@ def pallas_qualifies(batch: RequestBatch) -> bool:
     return True
 
 
-def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
+def _kernel(tile, bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
             dlo_ref, dhi_ref, elo_ref, ehi_ref, glo_ref, ghi_ref,
             beh_ref, nlo_ref, nhi_ref, valid_ref,
             alg_ref, htl_ref, hth_ref, cpl_ref, cph_ref,
             rsl_ref, rsh_ref, rate_ref, gdl_ref, gdh_ref,
             _table_in, table_ref, st_o, rem_o, rlo_o, rhi_o, lim_o,
             flg_o, scratch, sem_in, sem_out):
-    """One grid step = one TILE of requests, strictly in order.
+    """One grid step = one ``tile`` of requests, strictly in order.
 
     scratch[j*8:(j+1)*8] holds request j's bucket copy iff j is its
     tile-first occurrence (brep[j] == j); later same-bucket requests
@@ -343,7 +363,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
                 sem_in.at[j]).start()
         return c
 
-    lax.fori_loop(0, TILE, issue_in, 0)
+    lax.fori_loop(0, tile, issue_in, 0)
 
     def wait_in(j, c):
         @pl.when(first_live(j))
@@ -354,7 +374,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
                 sem_in.at[j]).wait()
         return c
 
-    lax.fori_loop(0, TILE, wait_in, 0)
+    lax.fori_loop(0, tile, wait_in, 0)
 
     lane = lax.broadcasted_iota(i32, (SLOTS, WORDS), 1)
 
@@ -632,7 +652,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
 
         return c
 
-    lax.fori_loop(0, TILE, body, 0)
+    lax.fori_loop(0, tile, body, 0)
 
     # 3) scatter: write distinct live buckets back, then fence the tile
     # (the wait orders these stores before the NEXT tile's gathers)
@@ -645,7 +665,7 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
                 sem_out.at[j]).start()
         return c
 
-    lax.fori_loop(0, TILE, issue_out, 0)
+    lax.fori_loop(0, tile, issue_out, 0)
 
     def wait_out(j, c):
         @pl.when(first_live(j))
@@ -656,14 +676,14 @@ def _kernel(bb_ref, brep_ref, klo_ref, khi_ref, hits_ref, lim_ref,
                 sem_out.at[j]).wait()
         return c
 
-    lax.fori_loop(0, TILE, wait_out, 0)
+    lax.fori_loop(0, tile, wait_out, 0)
 
 
 N_COLS = 26  # SMEM request columns (see _kernel signature order)
 
 
-def _call_kernel(rows, cols, interpret: bool):
-    """cols: N_COLS int32 arrays shaped [G, 1, TILE] (_kernel order).
+def _call_kernel(rows, cols, interpret: bool, tile: int = TILE):
+    """cols: N_COLS int32 arrays shaped [G, 1, tile] (_kernel order).
 
     The singleton middle axis is load-bearing on real Mosaic: a block's
     last two dims must be divisible by (8, 128) or equal the array's —
@@ -671,15 +691,27 @@ def _call_kernel(rows, cols, interpret: bool):
     on-chip 2026-08-01), while [G, 1, TILE] with (1, 1, TILE) blocks
     has last-two dims (1, TILE) == the array's, which is allowed."""
     G = cols[0].shape[0]
-    smem_tile = pl.BlockSpec((1, 1, TILE), lambda i: (i, 0, 0),
+    smem_tile = pl.BlockSpec((1, 1, tile), lambda i: (i, 0, 0),
                              memory_space=pltpu.SMEM)
-    out_tile = pl.BlockSpec((1, 1, TILE), lambda i: (i, 0, 0),
+    out_tile = pl.BlockSpec((1, 1, tile), lambda i: (i, 0, 0),
                             memory_space=pltpu.SMEM)
     table_spec = pl.BlockSpec(memory_space=pl.ANY)
-    o32 = jax.ShapeDtypeStruct((G, 1, TILE), jnp.int32)
-    with jax.enable_x64(False):
+    o32 = jax.ShapeDtypeStruct((G, 1, tile), jnp.int32)
+    # jax.enable_x64 left the top-level namespace in jax 0.4.3x (this
+    # image raises AttributeError on it); the experimental alias is the
+    # stable spelling of the same x64-off trace scope.  The scope wraps
+    # only the REAL Mosaic build: on jax 0.4.37 the interpreter's grid
+    # loop captures x64 carries from the enclosing trace, and flipping
+    # x64 off mid-trace emits mixed i32/i64 while-carries that fail MLIR
+    # verification (this image's "jax 0.4.37 kills pallas" breakage);
+    # the kernel body itself is explicitly typed, so the interpret path
+    # needs no ambient-dtype pinning.
+    import contextlib
+    scope = (contextlib.nullcontext() if interpret
+             else jax.experimental.enable_x64(False))
+    with scope:
         return pl.pallas_call(
-            _kernel,
+            partial(_kernel, tile),
             grid=(G,),
             in_specs=[smem_tile] * N_COLS + [table_spec],
             out_specs=[table_spec] + [out_tile] * 6,
@@ -687,16 +719,17 @@ def _call_kernel(rows, cols, interpret: bool):
             + [o32] * 6,
             input_output_aliases={N_COLS: 0},
             scratch_shapes=[
-                pltpu.VMEM((TILE * SLOTS, WORDS), jnp.int32),
-                pltpu.SemaphoreType.DMA((TILE,)),
-                pltpu.SemaphoreType.DMA((TILE,)),
+                pltpu.VMEM((tile * SLOTS, WORDS), jnp.int32),
+                pltpu.SemaphoreType.DMA((tile,)),
+                pltpu.SemaphoreType.DMA((tile,)),
             ],
             interpret=interpret,
         )(*cols, rows)
 
 
 def decide_batch_pallas_impl(table: PallasTable, batch: RequestBatch,
-                             now_ms, *, interpret: bool = False
+                             now_ms, *, interpret: bool = False,
+                             tile: int = 0
                              ) -> tuple[PallasTable, StepOutput]:
     """Unjitted kernel step — for embedding in larger programs (the
     Pallas serving engine wraps it in shard_map; plain callers use the
@@ -704,9 +737,13 @@ def decide_batch_pallas_impl(table: PallasTable, batch: RequestBatch,
 
     Same contract as core/step.py › decide_batch for batches inside
     the kernel's domain (``pallas_qualifies``) — the parity tests
-    assert identical decisions on shared request streams.
+    assert identical decisions on shared request streams.  ``tile``
+    (requests per grid step) 0 resolves the GUBER_PALLAS_TILE knob at
+    trace time; engines resolve it once at build and pass it explicitly
+    so a live env flip can't desync compiled programs.
     """
     i32, i64 = jnp.int32, jnp.int64
+    TILE = tile if tile else pallas_tile()
     cap = table.rows.shape[0]
     n_buckets = cap // SLOTS
     B = batch.key.shape[0]
@@ -777,7 +814,7 @@ def decide_batch_pallas_impl(table: PallasTable, batch: RequestBatch,
     # rule (see _call_kernel)
     cols = [c.reshape(G, 1, TILE) for c in [bt, brep] + cols1d[1:]]
     rows2, st, rem, rlo, rhi, lim, flg = _call_kernel(
-        table.rows, cols, interpret)
+        table.rows, cols, interpret, TILE)
 
     def unpad(x):
         return x.reshape(-1)[:B]
@@ -800,8 +837,27 @@ def decide_batch_pallas_impl(table: PallasTable, batch: RequestBatch,
         err=vb & err, over_count=over, insert_count=inserts)
 
 
+def fused_tap_columns(batch: RequestBatch, out: StepOutput):
+    """[4, B] int64 heavy-hitter tap emitted BY THE SAME device program
+    as the decision step (ISSUE 8): rows are (khash bit-viewed i64,
+    hits, over_limit, served).  The analytics worker drains this device
+    array off the serving path (analytics.KeyAnalytics.tap_device) —
+    the host-side column copies the dispatcher's tap_packed made per
+    wave are deleted for fused engines.  ``served`` gates padding,
+    invalid rows and table_full rows out of the sketch exactly as the
+    host tap's job-scoped columns did."""
+    i64 = jnp.int64
+    served = batch.valid & (~out.err)
+    return jnp.stack([
+        lax.bitcast_convert_type(
+            jnp.asarray(batch.key).astype(jnp.uint64), i64),
+        jnp.asarray(batch.hits, i64),
+        (out.status == 1).astype(i64),
+        served.astype(i64)])
+
+
 #: Jitted/donated entry point (the bench duel + battery callers):
 #: table aliases in/out like decide_batch_donated.
 decide_batch_pallas = jax.jit(decide_batch_pallas_impl,
-                              static_argnames=("interpret",),
+                              static_argnames=("interpret", "tile"),
                               donate_argnums=(0,))

@@ -407,6 +407,37 @@ class MeshGlobalEngine:
         out = np.asarray(packed)
         return out[0], out[1], out[2], out[3], out[4] != 0
 
+    # ---- fused-engine hooks (ISSUE 8) ----------------------------------
+
+    def run_fused(self, fn):
+        """One fused serving launch under the tier's state lock: the
+        fused engine (parallel/pallas_engine.py › FusedServingMixin)
+        folds this tier's home-replica decide AND the accumulator
+        scatter-add into ITS wave program, deleting the separate
+        serving dispatch this class's ``check_columns`` costs.
+        ``fn(state, active_acc)`` must return (new_state, new_acc,
+        result); both store back atomically w.r.t. the fold/pins —
+        the double-buffer discipline holds because the launch writes
+        only the ACTIVE buffer (the fold reads retired ones)."""
+        with self._state_mu:
+            st, acc, result = fn(self.state, self._acc[self._active])
+            self.state = st
+            self._acc[self._active] = acc
+            return result
+
+    def note_injected(self, hits: int) -> None:
+        """Conservation-ledger feed for fused waves: the fused step
+        counts applied mesh hits on device (the exact amount its
+        scatter added to the active accumulator), so the
+        folded == injected oracle stays exact across both serving
+        paths."""
+        if hits <= 0:
+            return
+        with self._state_mu:
+            self.injected_hits += hits
+            if self._first_unfolded_t is None:
+                self._first_unfolded_t = time.monotonic()
+
     # ---- the reconcile collective --------------------------------------
 
     def swap_accum(self) -> int:

@@ -31,6 +31,8 @@ mode's sweep is a plain vectorized expire-clear over rows).
 """
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 import jax
@@ -39,9 +41,12 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.batch import RequestBatch
+from ..core.step import decide_batch_impl
 from ..ops import pallas_step as ps
-from .mesh import SHARD_AXIS, shard_map
+from .mesh import SHARD_AXIS, XLA_EXEC_MU, shard_map
 from .sharded import PACK32, PACK64, ShardedEngine
+
+log = logging.getLogger("gubernator_tpu.pallas_engine")
 
 #: SoA column → (word extractor) mapping used by snapshot/gather.
 _I64_PAIRS = {"duration": (ps.W_DLO, ps.W_DHI),
@@ -197,6 +202,21 @@ def _place_into_buckets(buckets: np.ndarray, group_id: np.ndarray,
     return placed
 
 
+def _batch_from_packed(a64, a32) -> RequestBatch:
+    """Packed wire matrices → RequestBatch (the PACK64/PACK32 layout)."""
+    return RequestBatch(
+        key=lax.bitcast_convert_type(a64[0], jnp.uint64),
+        hits=a64[1], limit=a64[2], duration=a64[3], eff_ms=a64[4],
+        greg_end=a64[5], burst=a64[6], now=a64[7],
+        behavior=a32[0], algorithm=a32[1], valid=a32[2] != 0)
+
+
+def _pack_outputs(out) -> jax.Array:
+    return jnp.stack([
+        out.status.astype(jnp.int64), out.remaining, out.reset_time,
+        out.limit, out.err.astype(jnp.int64)])
+
+
 def make_pallas_step_packed(mesh, interpret: bool = False):
     """shard_map twin of make_sharded_step_packed over the kernel:
     (rows, a64, a32, now) → (rows, [5,B] i64 outputs, counters).  The
@@ -204,16 +224,10 @@ def make_pallas_step_packed(mesh, interpret: bool = False):
     S = SHARD_AXIS
 
     def _step(rows, a64, a32, now):
-        batch = RequestBatch(
-            key=lax.bitcast_convert_type(a64[0], jnp.uint64),
-            hits=a64[1], limit=a64[2], duration=a64[3], eff_ms=a64[4],
-            greg_end=a64[5], burst=a64[6], now=a64[7],
-            behavior=a32[0], algorithm=a32[1], valid=a32[2] != 0)
+        batch = _batch_from_packed(a64, a32)
         tbl, out = ps.decide_batch_pallas_impl(
             ps.PallasTable(rows=rows), batch, now, interpret=interpret)
-        packed = jnp.stack([
-            out.status.astype(jnp.int64), out.remaining, out.reset_time,
-            out.limit, out.err.astype(jnp.int64)])
+        packed = _pack_outputs(out)
         over = lax.psum(out.over_count, S)
         ins = lax.psum(out.insert_count, S)
         return tbl.rows, packed, (over, ins)
@@ -226,8 +240,308 @@ def make_pallas_step_packed(mesh, interpret: bool = False):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-class PallasServingEngine(ShardedEngine):
+# ---- the fused serving step (ISSUE 8) ----------------------------------
+#
+# ONE device program per wave: hash-probe/slot-resolve, token- and
+# leaky-bucket update, over-limit decision, the heavy-hitter tap columns
+# (ops/pallas_step.py › fused_tap_columns — analytics drains the device
+# array, no host-side column copies), and — when the mesh-GLOBAL tier is
+# bound — the home-shard replica decision PLUS the scatter-add into the
+# shard's active hit accumulator, which deletes meshglobal's separate
+# serving dispatch: a wave that mixes plain and mesh-GLOBAL rows costs
+# one launch instead of two.
+#
+# ``flavor`` picks the decision kernel the program embeds:
+#   "pallas" — the Mosaic bucket-table kernel (the TPU serving engine;
+#              interpret-mode off-TPU, parity/testing only);
+#   "xla"    — core/step.py's compiled XLA step over the SoA table (the
+#              CPU opt-in: compiled — not interpret — small-shape
+#              kernels with identical decisions by construction).
+
+
+def _make_serve(flavor: str, interpret: bool, tile: int):
+    if flavor == "pallas":
+        def _serve(state, batch, now):
+            tbl, out = ps.decide_batch_pallas_impl(
+                ps.PallasTable(rows=state), batch, now,
+                interpret=interpret, tile=tile)
+            return tbl.rows, out
+        return _serve
+    if flavor != "xla":
+        raise ValueError(f"unknown fused-step flavor {flavor!r}")
+
+    def _serve(state, batch, now):
+        return decide_batch_impl(state, batch, now)
+
+    return _serve
+
+
+def make_fused_step_packed(mesh, *, flavor: str, interpret: bool = False,
+                           tile: int = 0, donate: bool = True):
+    """(state, a64, a32, now) → (state, packed [5,B] i64, tap [4,B]
+    i64, (over, insert)) — the fused program for waves with no
+    mesh-GLOBAL rows.  State layout follows ``flavor`` (bucket rows vs
+    SoA TableState)."""
+    S = SHARD_AXIS
+    serve = _make_serve(flavor, interpret, tile)
+
+    def _step(state, a64, a32, now):
+        batch = _batch_from_packed(a64, a32)
+        state, out = serve(state, batch, now)
+        packed = _pack_outputs(out)
+        tap = ps.fused_tap_columns(batch, out)
+        over = lax.psum(out.over_count, S)
+        ins = lax.psum(out.insert_count, S)
+        return state, packed, tap, (over, ins)
+
+    state_spec = P(S, None) if flavor == "pallas" else P(S)
+    sharded = shard_map(
+        _step, mesh=mesh,
+        in_specs=(state_spec, P(None, S), P(None, S), P()),
+        out_specs=(state_spec, P(None, S), P(None, S), P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_fused_mesh_step_packed(mesh, *, flavor: str, mesh_cap: int,
+                                interpret: bool = False, tile: int = 0):
+    """The mesh-GLOBAL fused program (GUBER_GLOBAL_MODE=mesh with a
+    fused engine): rows whose ``mslot`` is >= 0 decide on the key's
+    HOME-shard replica of the mesh-GLOBAL table and scatter-add their
+    applied hits into that shard's ACTIVE accumulator (the conservation
+    ledger meshglobal's reconcile fold psums); all other rows take the
+    serving kernel.  One launch serves both lanes — the separate
+    meshglobal serving dispatch is deleted.
+
+    Host routing already sends every request to ``shard_of(khash)``,
+    which IS the mesh tier's home-shard function, so a mesh row always
+    lands on the shard whose replica row is exact.
+
+    (state, mstate, acc, a64, a32, mslot, now) →
+    (state, mstate, acc, packed, tap, (over, insert, mesh_hits)).
+    """
+    S = SHARD_AXIS
+    serve = _make_serve(flavor, interpret, tile)
+
+    def _step(state, mstate, acc, a64, a32, mslot, now):
+        batch = _batch_from_packed(a64, a32)
+        mesh_rows = mslot >= 0
+        main = batch._replace(valid=batch.valid & (~mesh_rows))
+        state, out = serve(state, main, now)
+        # mesh lane: home replica decide (bit-identical to the
+        # owner-sharded path — same decide_batch_impl, same row state)
+        mst = jax.tree.map(lambda x: x[0], mstate)
+        a = acc[0]
+        mb = batch._replace(valid=batch.valid & mesh_rows)
+        mst, mout = decide_batch_impl(mst, mb, now)
+        ok = mb.valid & (~mout.err)
+        applied = jnp.where(ok, jnp.maximum(batch.hits, 0),
+                            jnp.int64(0))
+        # pinned slot comes straight from the host slot map (mslot) —
+        # no re-probe; erred rows never mutated state so they don't
+        # accumulate either (exactly meshglobal's step contract)
+        a = a.at[jnp.where(ok, mslot, mesh_cap)].add(applied,
+                                                     mode="drop")
+        # merge the two lanes row-wise
+        from ..core.step import StepOutput
+
+        merged = StepOutput(
+            status=jnp.where(mesh_rows, mout.status, out.status),
+            remaining=jnp.where(mesh_rows, mout.remaining,
+                                out.remaining),
+            reset_time=jnp.where(mesh_rows, mout.reset_time,
+                                 out.reset_time),
+            limit=jnp.where(mesh_rows, mout.limit, out.limit),
+            err=jnp.where(mesh_rows, mout.err, out.err),
+            over_count=out.over_count + mout.over_count,
+            insert_count=out.insert_count + mout.insert_count)
+        packed = _pack_outputs(merged)
+        tap = ps.fused_tap_columns(batch, merged)
+        over = lax.psum(merged.over_count, S)
+        ins = lax.psum(merged.insert_count, S)
+        mesh_hits = lax.psum(applied.sum(), S)
+        return (state, jax.tree.map(lambda x: x[None], mst), a[None],
+                packed, tap, (over, ins, mesh_hits))
+
+    state_spec = P(S, None) if flavor == "pallas" else P(S)
+    sharded = shard_map(
+        _step, mesh=mesh,
+        in_specs=(state_spec, P(S), P(S), P(None, S), P(None, S),
+                  P(S), P()),
+        out_specs=(state_spec, P(S), P(S), P(None, S), P(None, S),
+                   P()),
+        check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+class FusedServingMixin:
+    """Fused gather–decide–scatter serving (ISSUE 8): the engine's step
+    is ONE device program per wave that also emits the heavy-hitter tap
+    columns on device and, when the mesh-GLOBAL tier is bound, folds
+    the replica decision + accumulator scatter into the same launch.
+
+    The dispatcher reads the two class flags: ``fused_serving`` makes
+    the PhaseLedger's pack/device/resolve partition collapse into a
+    ``device`` phase that absorbs what fusion deletes, and
+    ``fused_tap`` suppresses its host-side per-wave column copies (the
+    engine delivered the device tap at launch).
+    """
+
+    #: dispatcher collapses the wave's pack mark into `device`
+    fused_serving = True
+    #: dispatcher skips host-side column taps (device tap instead)
+    fused_tap = True
+    #: decision-kernel flavor the fused program embeds (subclass sets)
+    _flavor = "xla"
+
+    def _fused_setup(self) -> None:
+        #: analytics sink for device taps + instance metrics registry:
+        #: both single-assigned at instance wiring BEFORE serving
+        #: starts, then read-only on the launch path
+        self.tap_sink = None  # lock-free: set once pre-serving, read-only after
+        self.metrics_ref = None  # lock-free: set once pre-serving, read-only after
+        #: bound MeshGlobalEngine (GUBER_GLOBAL_MODE=mesh): a single
+        #: reference swap — a wave racing an unbind serves one more
+        #: mesh wave, which the tier's state lock keeps exact
+        self._mge = None  # lock-free: single ref swap; state mutations under mge._state_mu
+        self._mesh_step = None  # lock-free: launch path only (engine lock serializes)
+        self._tap_mute = False  # lock-free: engine calls serialized by the engine lock
+        self.fused_wave_count = 0  # lock-free: launch path only (engine lock serializes)
+        self.mesh_fused_hits = 0  # lock-free: sync path only (engine lock serializes)
+
+    # ---- mesh-GLOBAL binding -------------------------------------------
+
+    def bind_mesh(self, mge) -> None:
+        """Attach the mesh-GLOBAL tier: waves whose ``mslot`` column
+        marks pinned rows serve them on the home replica + accumulator
+        INSIDE the fused program (instance.py wires this when
+        GUBER_GLOBAL_MODE=mesh and the engine is fused)."""
+        if mge.n != self.n:
+            raise ValueError("mesh-GLOBAL tier and serving engine must "
+                             "share the device mesh")
+        self._mesh_step = None
+        self._mge = mge
+
+    def unbind_mesh(self) -> None:
+        """Detach (mesh stand-down): subsequent waves serve every row
+        on the sharded path; a wave already launched finishes under the
+        tier's state lock first."""
+        self._mge = None
+
+    @property
+    def mesh_bound(self) -> bool:
+        return self._mge is not None
+
+    def _ensure_mesh_step(self, mge):
+        if self._mesh_step is None:
+            self._mesh_step = make_fused_mesh_step_packed(
+                self.mesh, flavor=self._flavor, mesh_cap=mge.capacity,
+                interpret=getattr(self, "_interpret", False),
+                tile=getattr(self, "_tile", 0))
+        return self._mesh_step
+
+    def warmup_mesh_fused(self, now_ms: int = 1) -> None:
+        """Pre-compile the fused mesh program for every wave bucket —
+        an all-invalid wave whose one marked mesh row is invalid
+        (nothing moves, the scatter drops) — so the first GLOBAL
+        caller never pays the compile (same contract as warmup)."""
+        mge = self._mge
+        if mge is None:
+            return
+        from ..core.batch import empty_batch
+        from .sharded import pack_wave_host
+
+        for bw in self.wave_buckets:
+            a64, a32 = pack_wave_host(empty_batch(self.n * bw))
+            mblk = np.full(self.n * bw, -1, np.int32)
+            mblk[0] = 0  # invalid row: compiles the mesh lane only
+            self._finish_wave(*self._launch_arrays(a64, a32, now_ms,
+                                                   mblk))
+
+    # ---- fused launch ---------------------------------------------------
+
+    def _deliver_tap(self, tap) -> None:
+        """Hand the device tap array to analytics (no host copy here:
+        np.asarray happens on the analytics worker thread)."""
+        self.fused_wave_count += 1
+        m = self.metrics_ref
+        if m is not None:
+            m.pallas_fused_waves.inc()
+        sink = self.tap_sink
+        if sink is not None and not self._tap_mute:
+            try:
+                sink(tap)
+            except Exception:  # noqa: BLE001 - analytics only
+                log.exception("fused tap delivery")
+
+    def check_batch(self, reqs, now_ms: int):
+        # object-lane waves are tapped by the dispatcher WITH key names
+        # (the sketch's name side table); mute the device tap for this
+        # call so the wave isn't double-counted.  Engine calls are
+        # serialized by the dispatcher's engine lock, so the plain
+        # attribute is effectively single-threaded.
+        self._tap_mute = True
+        try:
+            return super().check_batch(reqs, now_ms)
+        finally:
+            self._tap_mute = False
+
+    def _launch_arrays(self, a64, a32, now_ms: int, mblk=None):
+        """One fused launch: decisions + device tap (+ mesh-GLOBAL
+        replica decide and accumulator scatter when bound and the wave
+        carries pinned rows)."""
+        mge = self._mge
+        if (mge is None or mblk is None
+                or not bool((np.asarray(mblk) >= 0).any())):
+            with XLA_EXEC_MU:
+                if self.n > 1:
+                    a64 = jax.device_put(a64, self._mat_sharding)
+                    a32 = jax.device_put(a32, self._mat_sharding)
+                self.state, packed, tap, counters = self._step(
+                    self.state, a64, a32, np.int64(now_ms))
+            self._deliver_tap(tap)
+            return packed, counters
+        step = self._ensure_mesh_step(mge)
+
+        def _go(mstate, acc):
+            nonlocal a64, a32, mblk
+            with XLA_EXEC_MU:
+                if self.n > 1:
+                    a64 = jax.device_put(a64, self._mat_sharding)
+                    a32 = jax.device_put(a32, self._mat_sharding)
+                    mblk = jax.device_put(mblk, self._batch_sharding)
+                (st, mst, acc2, packed, tap,
+                 counters) = step(self.state, mstate, acc, a64, a32,
+                                  mblk, np.int64(now_ms))
+            self.state = st
+            return mst, acc2, (packed, tap, counters)
+
+        packed, tap, counters = mge.run_fused(_go)
+        self._deliver_tap(tap)
+        return packed, counters
+
+    def _finish_wave(self, packed, counters):
+        cols = super()._finish_wave(packed, counters[:2])
+        if len(counters) > 2:
+            mh = int(counters[2])
+            if mh:
+                # conservation ledger: the fused scatter's applied mesh
+                # hits ARE the injected side of meshglobal's
+                # folded == injected oracle
+                self.mesh_fused_hits += mh
+                mge = self._mge
+                if mge is not None:
+                    mge.note_injected(mh)
+                m = self.metrics_ref
+                if m is not None:
+                    m.pallas_mesh_fused_hits.inc(mh)
+        return cols
+
+
+class PallasServingEngine(FusedServingMixin, ShardedEngine):
     """ShardedEngine over the kernel's bucketized table (module doc)."""
+
+    _flavor = "pallas"
 
     def _init_table_and_step(self) -> None:
         if self.cap_local < ps.SLOTS or (self.cap_local
@@ -241,8 +555,11 @@ class PallasServingEngine(ShardedEngine):
         # interpret everywhere the Mosaic kernel can't compile natively
         # (same gate as sharded.py's fused sweep)
         self._interpret = jax.default_backend() != "tpu"
-        self._step = make_pallas_step_packed(self.mesh,
-                                             interpret=self._interpret)
+        self._tile = ps.pallas_tile()
+        self._step = make_fused_step_packed(
+            self.mesh, flavor="pallas", interpret=self._interpret,
+            tile=self._tile)
+        self._fused_setup()
         self._rows_sharding = sh
 
         # ONE fused program serves occupancy AND the saturation
@@ -261,10 +578,15 @@ class PallasServingEngine(ShardedEngine):
 
     # ---- serving -------------------------------------------------------
 
-    def _mask_out_of_domain(self, batch):
+    def _mask_out_of_domain(self, batch, mslot=None):
         """Invalidate rows outside the kernel's value domain; returns
-        (masked batch, ood index array or None)."""
+        (masked batch, ood index array or None).  Mesh-GLOBAL rows
+        (mslot >= 0) are exempt: they decide on the replica table's XLA
+        math inside the fused program, which has the full int64
+        domain."""
         mask = ps.pallas_value_domain_mask(batch)
+        if mslot is not None:
+            mask = mask | (np.asarray(mslot) >= 0)
         v = np.asarray(batch.valid)
         ood = v & ~mask
         if not ood.any():
@@ -284,16 +606,19 @@ class PallasServingEngine(ShardedEngine):
         full[ood] = True
         return st, lim, rem, rst, full
 
-    def check_packed(self, batch, khash, now_ms: int) -> tuple:
-        batch, ood = self._mask_out_of_domain(batch)
+    def check_packed(self, batch, khash, now_ms: int,
+                     mslot=None) -> tuple:
+        batch, ood = self._mask_out_of_domain(batch, mslot)
         return self._merge_ood(
-            super().check_packed(batch, khash, now_ms), ood)
+            super().check_packed(batch, khash, now_ms, mslot=mslot),
+            ood)
 
-    def launch_packed(self, batch, khash, now_ms: int):
+    def launch_packed(self, batch, khash, now_ms: int, mslot=None):
         # the pipelined dispatcher path calls launch/sync directly —
         # the domain gate must cover it too
-        batch, ood = self._mask_out_of_domain(batch)
-        return (super().launch_packed(batch, khash, now_ms), ood)
+        batch, ood = self._mask_out_of_domain(batch, mslot)
+        return (super().launch_packed(batch, khash, now_ms,
+                                      mslot=mslot), ood)
 
     def sync_packed(self, token, engine_lock=None) -> tuple:
         inner, ood = token
@@ -513,3 +838,85 @@ class PallasServingEngine(ShardedEngine):
         self.state = jax.device_put(jnp.asarray(host),
                                     self._rows_sharding)
         return int(counts[placed].sum())
+
+
+class XlaFusedEngine(FusedServingMixin, ShardedEngine):
+    """The fused serving engine's off-TPU flavor (GUBER_ENGINE=pallas
+    on a CPU backend): the SAME one-launch-per-wave fused program —
+    decisions + device tap + optional mesh-GLOBAL replica decide and
+    accumulator scatter — with core/step.py's COMPILED XLA step as the
+    embedded decision kernel instead of the Mosaic bucket kernel.
+
+    This is the "compiled — not interpret — small-shape kernels"
+    opt-in: interpret-mode Pallas on CPU measures nothing (orders
+    slower by construction), so the CPU flavor serves from compiled
+    XLA kernels at small wave shapes (default wave buckets 256/2048 —
+    fast compiles, the 1-core host's coalescing sweet spot) while
+    keeping decisions bit-identical to ``ShardedEngine`` by
+    construction (same decide_batch_impl, same SoA table, so the full
+    engine protocol — grow, sweep, snapshot — is inherited unchanged).
+    """
+
+    _flavor = "xla"
+
+    #: small-shape default wave buckets (GUBER_WAVE_BUCKETS overrides):
+    #: top bucket 1024 matches the classic engine's FIRST bucket, so a
+    #: 1000-row wire batch rides the same wave width both ways — the
+    #: A/B compares fusion, not wave quantization
+    SMALL_WAVE_BUCKETS = (256, 1024)
+
+    def __init__(self, mesh=None, capacity_per_shard: int = 1 << 16,
+                 batch_per_shard: int = 1024, auto_grow_limit: int = 0,
+                 wave_buckets=None):
+        import os as _os
+
+        if wave_buckets is None \
+                and not _os.environ.get("GUBER_WAVE_BUCKETS", ""):
+            wave_buckets = self.SMALL_WAVE_BUCKETS
+        super().__init__(mesh, capacity_per_shard, batch_per_shard,
+                         auto_grow_limit=auto_grow_limit,
+                         wave_buckets=wave_buckets)
+
+    def _init_table_and_step(self) -> None:
+        import os as _os
+
+        from .mesh import shard_table
+
+        self.state = shard_table(self.mesh, self.cap_local)
+        # same donation default/opt-out as the classic engine (the
+        # bucket-kernel flavor always donates: the kernel owns its
+        # scatters in place)
+        self._step = make_fused_step_packed(
+            self.mesh, flavor="xla",
+            donate=_os.environ.get("GUBER_STEP_DONATE", "1") == "1")
+        self._fused_setup()
+
+
+def resolve_engine_kind(selector: str, step_impl: str,
+                        backend: str) -> str:
+    """GUBER_ENGINE / Config.engine → concrete engine kind.
+
+    - ``auto`` (or unset): the fused Pallas engine on TPU, the classic
+      XLA sharded engine elsewhere (the pre-ISSUE-8 default);
+    - ``pallas``: fused serving everywhere — the Mosaic bucket kernel
+      on TPU, the compiled XLA fused flavor off-TPU;
+    - ``xla`` / ``sharded``: the classic engine, explicitly.
+
+    The legacy ``GUBER_STEP_IMPL=pallas`` knob keeps meaning "the
+    bucket-kernel engine, even off-TPU (interpret)" — the kernel-parity
+    mode tests and the probe drive; GUBER_ENGINE wins when both are
+    set.  Unknown values raise: a typo must not silently serve a mode
+    whose domain restrictions the operator believes are live.
+    """
+    sel = (selector or "").strip().lower()
+    if sel not in ("", "auto", "pallas", "xla", "sharded"):
+        raise ValueError(
+            f"unknown GUBER_ENGINE {selector!r} (want auto, pallas, "
+            "xla or sharded)")
+    if sel in ("", "auto"):
+        if step_impl == "pallas":
+            return "pallas-kernel"
+        return "pallas-fused" if backend == "tpu" else "xla-classic"
+    if sel == "pallas":
+        return "pallas-fused" if backend == "tpu" else "xla-fused"
+    return "xla-classic"

@@ -302,6 +302,15 @@ class ShardedEngine:
     reference's V1Instance request router (gubernator.go ›
     GetRateLimits → picker.Get → local/forward split)."""
 
+    #: capability flags the dispatcher reads (ISSUE 8): fused engines
+    #: (parallel/pallas_engine.py › FusedServingMixin) flip both — the
+    #: wave's pack mark collapses into the `device` phase and the
+    #: dispatcher's host-side column taps are skipped (the fused step
+    #: emits the tap columns on device).  The classic engine keeps the
+    #: classic phase partition and host taps.
+    fused_serving = False
+    fused_tap = False
+
     def __init__(self, mesh=None, capacity_per_shard: int = 1 << 16,
                  batch_per_shard: int = 1024,
                  auto_grow_limit: int = 0,
@@ -475,15 +484,20 @@ class ShardedEngine:
             waves.append((idx, slots, bw_w))
         return waves
 
-    def _fill_packed(self, batch: RequestBatch, idx, slots, bw_w):
+    def _fill_packed(self, batch: RequestBatch, idx, slots, bw_w,
+                     mslot=None):
         """Scatter a wave's requests straight into a LEASED pair of
         packed wire matrices (one [8, n·Bw] i64 + one [3, n·Bw] i32
         from ``wave_pool``): fuses the old glob-fill + pack_wave_host
         into a single set of writes, without the per-wave allocation
         the old path paid (at a fast device step — TPU: ~0.2 ms — the
         host-side copies and allocator churn ARE the serving ceiling).
-        Returns (a64, a32, lease); the caller must ``lease.release()``
-        once the launch has consumed the buffers, on every path.
+        Returns (a64, a32, lease, mblk); the caller must
+        ``lease.release()`` once the launch has consumed the buffers,
+        on every path.  ``mslot`` (ISSUE 8, fused engines only) is the
+        per-request mesh-GLOBAL slot column; it rides a plain -1-filled
+        block array (``mblk``), not the lease — mesh waves are the
+        GLOBAL minority, pooling them would tax every wave.
         Padding rows keep empty_batch semantics: zeros everywhere,
         eff_ms 1, valid false."""
         lease = self.wave_pool.lease(self.n * bw_w)
@@ -494,26 +508,37 @@ class ShardedEngine:
             a64[i][slots] = np.asarray(getattr(batch, f))[idx]
         for i, f in enumerate(PACK32):
             a32[i][slots] = np.asarray(getattr(batch, f))[idx]
-        return a64, a32, lease
+        mblk = None
+        if mslot is not None:
+            mblk = np.full(self.n * bw_w, -1, np.int32)
+            mblk[slots] = np.asarray(mslot)[idx]
+        return a64, a32, lease, mblk
 
     def launch_packed(self, batch: RequestBatch, khash: np.ndarray,
-                      now_ms: int):
+                      now_ms: int, mslot=None):
         """Pipeline phase 1 of check_packed: route and LAUNCH the waves
         without blocking on device results, so the dispatcher can
         overlap the next wave's host work with this one's device time.
         Returns an opaque token for ``sync_packed``.  State threads
         through the launches, so later launches are ordered after these
-        device-side regardless of when anyone syncs."""
+        device-side regardless of when anyone syncs.  ``mslot`` rides
+        the token so the sync-side retry keeps the rows' lanes."""
         pending = self._arrival_order(batch)
         launched = []
         for idx, slots, bw_w in self._build_waves(khash, pending):
-            a64, a32, lease = self._fill_packed(batch, idx, slots, bw_w)
+            a64, a32, lease, mblk = self._fill_packed(batch, idx, slots,
+                                                      bw_w, mslot)
             try:
-                packed, counters = self._launch_arrays(a64, a32, now_ms)
+                # positional mblk only when a mesh lane exists: tests
+                # and profilers wrap _launch_arrays with the classic
+                # 3-arg signature
+                packed, counters = (
+                    self._launch_arrays(a64, a32, now_ms) if mblk is None
+                    else self._launch_arrays(a64, a32, now_ms, mblk))
             finally:
                 lease.release()  # the launch copied the host operands
             launched.append((idx, slots, packed, counters))
-        return (batch, khash, now_ms, launched)
+        return (batch, khash, now_ms, launched, mslot)
 
     def sync_packed(self, token, engine_lock=None) -> tuple:
         """Pipeline phase 2: block on the launched waves and assemble
@@ -525,7 +550,7 @@ class ShardedEngine:
         acceptable: erred rows never mutated state, retries are the
         table-full corner, and the device clamps per-key time
         monotonically."""
-        batch, khash, now_ms, launched = token
+        batch, khash, now_ms, launched, mslot = token
         n = len(khash)
         status = np.zeros(n, np.int32)
         rem_o = np.zeros(n, np.int64)
@@ -548,10 +573,11 @@ class ShardedEngine:
 
             ei = np.asarray(sorted(err_idx))
             sub = type(batch)(*[np.asarray(c)[ei] for c in batch])
+            msub = None if mslot is None else np.asarray(mslot)[ei]
             with (engine_lock if engine_lock is not None
                   else contextlib.nullcontext()):
                 r_st, r_lim, r_rem, r_rst, r_full = self.check_packed(
-                    sub, khash[ei], now_ms)
+                    sub, khash[ei], now_ms, mslot=msub)
             status[ei] = r_st
             lim_o[ei] = r_lim
             rem_o[ei] = r_rem
@@ -567,11 +593,13 @@ class ShardedEngine:
             self._run_wave(empty_batch(self.n * bw), now_ms)
 
     def _launch_arrays(self, a64: np.ndarray, a32: np.ndarray,
-                       now_ms: int):
+                       now_ms: int, mblk=None):
         """Dispatch one packed wave without blocking on its results: 2
         uploads + the step (async on the device stream; state threads
         through, so later launches are ordered after this one
-        device-side).
+        device-side).  ``mblk`` (mesh-GLOBAL slot block) is a fused-
+        engine operand — the classic step has no mesh lane and ignores
+        it (only fused engines are ever handed mesh-routed rows).
 
         On a 1-shard mesh the packed matrices go to the jitted call as
         raw numpy: explicit device_put with a NamedSharding pays
@@ -717,7 +745,7 @@ class ShardedEngine:
         return responses_from_columns(cols, errs)
 
     def check_packed(self, batch: RequestBatch, khash: np.ndarray,
-                     now_ms: int) -> tuple:
+                     now_ms: int, mslot=None) -> tuple:
         """Columnar twin of ``check_batch``: full-length numpy columns in,
         response columns out — no per-request Python objects (the C++
         wire-ingest lane).  Returns (status i32[n], limit i64[n],
@@ -725,7 +753,10 @@ class ShardedEngine:
 
         Invalid rows (batch.valid False) come back zeroed; the caller
         owns their error strings.  Same wave routing, duplicate-order,
-        and sweep-retry semantics as check_batch.
+        and sweep-retry semantics as check_batch.  ``mslot`` (ISSUE 8):
+        per-request mesh-GLOBAL replica slot, -1 for sharded rows —
+        only fused engines receive it (instance.py gates on
+        ``engine.mesh_bound``).
         """
         n = len(khash)
         status = np.zeros(n, np.int32)
@@ -741,10 +772,14 @@ class ShardedEngine:
         while len(pending):
             err_idx: List[int] = []
             for idx, slots, bw_w in self._build_waves(khash, pending):
-                a64, a32, lease = self._fill_packed(batch, idx, slots,
-                                                    bw_w)
+                a64, a32, lease, mblk = self._fill_packed(
+                    batch, idx, slots, bw_w, mslot)
                 try:
-                    launched = self._launch_arrays(a64, a32, now_ms)
+                    # see launch_packed: 3-arg call when no mesh lane
+                    launched = (
+                        self._launch_arrays(a64, a32, now_ms)
+                        if mblk is None
+                        else self._launch_arrays(a64, a32, now_ms, mblk))
                 finally:
                     lease.release()  # launch copied the host operands
                 o_st, o_rem, o_rst, o_lim, o_err = self._finish_wave(

@@ -37,16 +37,31 @@ def test_section_child_writes_rows(tmp_path):
 
 
 def test_pallas_section_child_writes_row(tmp_path):
-    """The step_impl=pallas serving row (11_pallas_serving) through the
-    driver's real child protocol; a hostile GUBER_STEP_IMPL export must
-    not flip the engine under measurement."""
-    rows = _run_section("pallas", tmp_path, timeout=420,
-                        extra_env={"GUBER_STEP_IMPL": "xla"})
+    """The fused-serving A/B row (11_pallas_serving, ISSUE 8) through
+    the driver's real child protocol: compiled kernels (the interpret
+    toy row is gone — its number lives under pre_pr), bit-identical
+    fused-vs-xla decisions, the throughput ratio, and PhaseLedger
+    evidence of the deleted pack phase.  Hostile GUBER_STEP_IMPL /
+    GUBER_ENGINE exports must not flip the engines under measurement."""
+    rows = _run_section("pallas", tmp_path, timeout=600,
+                        extra_env={"GUBER_STEP_IMPL": "xla",
+                                   "GUBER_ENGINE": "xla"})
     r = rows["11_pallas_serving"]
+    assert r["engine"] == "xla_fused" and r["cpu_compiled"] is True
+    assert r["compiled_kernels"] is True
     assert r["wire_lane_decisions_per_s"] > 0
-    assert r["cpu_interpret_reduced"] is True
+    assert r["xla_wire_decisions_per_s"] > 0
+    assert r["fused_vs_xla"] > 0
+    assert r["ab_identical"] is True
+    assert r["fused_waves"] > 0
     assert r["svc_p99_ms"] > 0
-    assert "INTERPRET" in r["context"]
+    assert r["pre_pr"]["wire_lane_decisions_per_s"] == 80411
+    pd = r["phase_deleted"]
+    assert pd["deleted_phase"] == "pack"
+    assert pd["pack_absent_in_fused"] is True
+    assert pd["pack_present_in_xla"] is True
+    assert pd["partition_max_drift_ms"] <= 0.01
+    assert "COMPILED" in r["context"]
 
 
 def test_section_child_backend_mismatch_guard(tmp_path):

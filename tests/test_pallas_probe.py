@@ -1,0 +1,31 @@
+"""tools/pallas_probe.py smoke (ISSUE 8 satellite): the kernel
+bisection probe promised at PERF.md §(pallas) must run end-to-end on
+this image — toy kernel, real decision kernel, and the fused serving
+program each attributable separately, so an on-chip regression bisects
+to environment vs kernel vs fusion vs size."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE = os.path.join(REPO, "tools", "pallas_probe.py")
+
+
+def test_probe_smoke_all_stages_ok(tmp_path):
+    out = str(tmp_path / "probe.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               GUBER_PALLAS_PROBE_OUT=out)
+    r = subprocess.run([sys.executable, PROBE, "--smoke"], env=env,
+                       cwd=REPO, timeout=420, stdout=subprocess.PIPE,
+                       stderr=subprocess.PIPE)
+    assert r.returncode == 0, r.stderr.decode()[-500:]
+    with open(out) as f:
+        res = json.load(f)
+    assert res["smoke"] is True
+    for stage in ("toy", "kernel_small", "fused_small"):
+        assert res[stage]["ok"] is True, (stage, res[stage])
+    # the stages actually measured something attributable
+    assert res["kernel_small"]["out"]["decisions_per_s"] > 0
+    assert res["fused_small"]["out"]["tap_rows_served"] > 0
+    assert res["fused_small"]["out"]["fused_waves"] >= 1

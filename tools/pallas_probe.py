@@ -11,9 +11,18 @@ FULL untruncated error text written to /tmp/pallas_probe.json:
   2. kernel_small — the real decision kernel at a TINY shape
                     (CAP 2^12 table): does the failure depend on our
                     kernel, independent of size?
-  3. kernel_big   — the real kernel at the battery's failing shape
+  3. fused_small  — the fused serving program (ISSUE 8: kernel +
+                    device tap in ONE launch) at a small shape: if 2
+                    passes and this fails, the fusion wrapper broke,
+                    not the kernel.
+  4. kernel_big   — the real kernel at the battery's failing shape
                     (CAP 2^22 → 2^23-row bucket table) IF 1+2 passed:
                     is it a size/scratch limit?
+
+So a regression bisects: environment (toy) vs kernel (kernel_small)
+vs fusion (fused_small) vs table size (kernel_big).  ``--smoke`` runs
+stages 1-3 at tiny shapes — the tier-1 CI invocation
+(tests/test_pallas_probe.py), CPU-interpret friendly.
 
 Single-client rule: run ONLY when no other jax process holds the relay.
 
@@ -79,8 +88,11 @@ def toy():
         o_ref[...] = x_ref[...] + y_ref[...]
 
     x = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+    # off-TPU there is no Mosaic compiler — the interpreter is the
+    # only executable path (the CI smoke exercises exactly that)
     out = pl.pallas_call(
-        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32))(x, x)
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        interpret=jax.default_backend() != "tpu")(x, x)
     return {"sum": int(out.sum()), "backend": jax.default_backend()}
 
 
@@ -110,13 +122,14 @@ def _kernel_at(log2cap, B=4096, reps=16):
                               .astype(np.int32)),
         burst=jnp.full(n, 100, i64), valid=jnp.ones(n, bool))
     now0 = jnp.asarray(1_760_000_000_000, i64)
+    interp = jax.default_backend() != "tpu"  # Mosaic is TPU-only
     t = time.time()
-    pt, out = decide_batch_pallas(pt, batch, now0)
+    pt, out = decide_batch_pallas(pt, batch, now0, interpret=interp)
     jax.block_until_ready(out.status)
     compile_s = round(time.time() - t, 1)
     t = time.time()
     for _ in range(reps):
-        pt, out = decide_batch_pallas(pt, batch, now0)
+        pt, out = decide_batch_pallas(pt, batch, now0, interpret=interp)
     jax.block_until_ready(out.status)
     dt = time.time() - t
     err = float(np.asarray(out.err).mean())
@@ -127,18 +140,80 @@ def _kernel_at(log2cap, B=4096, reps=16):
             "backend": jax.default_backend()}
 
 
-def main():
+def _fused_at(log2cap, B=512, reps=4):
+    """The fused serving program (ISSUE 8) at a small shape: one
+    launch = decide + device tap (+ mesh scatter when bound).  Bisects
+    fused-program regressions from raw-kernel regressions: if
+    kernel_small passes and this fails, the fusion wrapper (shard_map
+    specs, tap stack, counters) broke, not the Mosaic kernel."""
+    import jax
+    import numpy as np
+
+    from bench import _keyhash as keyhash
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.pallas_engine import PallasServingEngine
+
+    rng = np.random.default_rng(5)
+    taps = []
+    eng = PallasServingEngine(make_mesh(n=1),
+                              capacity_per_shard=1 << log2cap,
+                              batch_per_shard=B)
+    eng.tap_sink = taps.append
+    from gubernator_tpu.core.batch import pack_columns
+
+    keys = keyhash((rng.zipf(1.1, size=B) % (1 << (log2cap - 1)))
+                   .astype(np.uint64))
+    batch, _ = pack_columns(
+        keys, np.ones(B, np.int64), np.full(B, 100, np.int64),
+        np.full(B, 60_000, np.int64), np.zeros(B, np.int32),
+        np.zeros(B, np.int32), np.full(B, 100, np.int64),
+        1_760_000_000_000)
+    t = time.time()
+    eng.check_packed(batch, keys, 1_760_000_000_000)
+    compile_s = round(time.time() - t, 1)
+    t = time.time()
+    for r in range(reps):
+        eng.check_packed(batch, keys, 1_760_000_000_000 + 1 + r)
+    dt = time.time() - t
+    tap = np.asarray(taps[-1])
+    served = int((tap[3] != 0).sum())
+    if not served:
+        raise RuntimeError("fused tap emitted no served rows")
+    return {"compile_s": compile_s,
+            "ms_per_wave": round(dt / reps * 1e3, 3),
+            "decisions_per_s": round(reps * B / dt),
+            "tap_rows_served": served,
+            "fused_waves": eng.fused_wave_count,
+            "backend": jax.default_backend()}
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes, skip kernel_big — the tier-1 "
+                         "CI invocation (CPU interpret)")
+    args = ap.parse_args(argv)
+
     from gubernator_tpu.cmd import maybe_pin_platform
 
     maybe_pin_platform()
     import jax
 
     res["backend_probe"] = jax.default_backend()
+    res["smoke"] = bool(args.smoke)
     save()
     ok_toy = attempt("toy", toy)
-    ok_small = attempt("kernel_small", lambda: _kernel_at(12))
-    if ok_toy and ok_small:
-        attempt("kernel_big", lambda: _kernel_at(22))
+    if args.smoke:
+        ok_small = attempt("kernel_small",
+                           lambda: _kernel_at(9, B=256, reps=2))
+        attempt("fused_small", lambda: _fused_at(9, B=128, reps=2))
+    else:
+        ok_small = attempt("kernel_small", lambda: _kernel_at(12))
+        attempt("fused_small", lambda: _fused_at(12, B=512, reps=4))
+        if ok_toy and ok_small:
+            attempt("kernel_big", lambda: _kernel_at(22))
     res["finished"] = time.strftime("%Y-%m-%d %H:%M:%S")
     save()
     print(json.dumps(res, indent=1)[:2000])

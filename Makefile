@@ -2,8 +2,8 @@
 
 PY ?= python
 
-.PHONY: test proto bench bench-pallas chaos tpu-session b-sweep daemon \
-        cluster lint native tsan asan racer check clean
+.PHONY: test proto bench bench-pallas bench-tiered chaos tpu-session \
+        b-sweep daemon cluster lint native tsan asan racer check clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -60,6 +60,12 @@ bench:
 # PhaseLedger phase_deleted evidence (ISSUE 8)
 bench-pallas:
 	GUBER_BENCH_SECTION=pallas $(PY) bench.py
+
+# the tiered-store capacity row (13_tiered_store) standalone: 1M-key
+# seeded skewed traffic vs a 4K-row device cap + host cold tier,
+# A/B'd byte-for-byte against an uncapped oracle (ISSUE 10)
+bench-tiered:
+	GUBER_BENCH_SECTION=tiered $(PY) bench.py
 
 # one-shot on-chip validation battery (run when a TPU is reachable)
 tpu-session:

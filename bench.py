@@ -1767,6 +1767,110 @@ def _sec_mesh():
     return {"12_mesh_global": row}
 
 
+def _sec_tiered():
+    """Tiered key store (ISSUE 10): seeded skewed traffic whose key
+    domain dwarfs a 4K-row device cap, served through the host cold
+    tier and A/B'd byte-for-byte against an UNCAPPED single-tier
+    oracle.  The verdict columns are the acceptance criteria: zero
+    error rows, exact conservation summed across BOTH tiers, and
+    bit-identical decisions; the capacity story (cold keys, hot-tier
+    hit rate, migration counters) rides in the same row."""
+    import jax
+
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.types import RateLimitRequest
+
+    nkeys = 20_000 if FAST else 1_000_000
+    rng = np.random.default_rng(13)
+    # one full pass over the domain guarantees nkeys DISTINCT keys; a
+    # zipf-hot overlay gives a band of keys the rank to clear admission
+    stream = np.concatenate([
+        rng.permutation(nkeys),
+        (rng.zipf(ZIPF_A, size=nkeys // 5) - 1) % nkeys])
+    B = 1000
+    pad = (-len(stream)) % B
+    if pad:
+        stream = np.concatenate([stream, stream[:pad]])
+    datas = _serialize_reqs(
+        [[RateLimitRequest(name="tier", unique_key=f"t{int(k)}", hits=1,
+                           limit=10 ** 9, duration=86_400_000)
+          for k in stream[base:base + B]]
+         for base in range(0, len(stream), B)])
+    sent = len(stream)
+
+    def _drive(inst):
+        inst.get_rate_limits_wire(datas[0], now_ms=NOW0)  # compile
+        t0 = time.perf_counter()
+        outs = [inst.get_rate_limits_wire(d, now_ms=NOW0 + 1)
+                for d in datas]
+        return sent / (time.perf_counter() - t0), outs
+
+    def _debits(inst) -> int:
+        arrays = inst.engine.snapshot()
+        total = int((10 ** 9 - arrays["remaining"]).sum())
+        if inst._tier is not None:
+            cold = inst._tier.snapshot_arrays()
+            if cold is not None:
+                total += int((10 ** 9 - cold["remaining"]).sum())
+        return total
+
+    row = {"n_shards": len(jax.devices()), "key_domain": nkeys,
+           "requests": sent + B, "device_cap_rows": 4096}
+    ti = V1Instance(Config(cache_size=4096, cache_autogrow_max=4096,
+                           tier_cold=True, tier_promote_threshold=4,
+                           hot_set_capacity=0, sweep_interval_ms=0),
+                    mesh=make_mesh())
+    try:
+        dps_tier, tier_outs = _drive(ti)
+        st = ti._tier.stats()
+        # the warm-up batch's debits land in the same tables, so the
+        # conservation target includes it
+        row.update({
+            "decisions_per_s": round(dps_tier),
+            "error_rows": _count_error_rows(tier_outs),
+            "conservation_exact": _debits(ti) == sent + B,
+            "cold_keys": st["cold_keys"],
+            "cold_served": st["cold_served"],
+            "hot_hit_rate": round(1 - st["cold_served"]
+                                  / max(sent + B, 1), 4),
+            "promotions": st["promotions"],
+            "demotions": st["demotions"],
+            "migrations_aborted": st["migrations_aborted"],
+            "cold_store_native": st["native"],
+        })
+    finally:
+        ti.close()
+    # "uncapped" still needs placement headroom: at ~0.5 load an 8-probe
+    # window can clog (~0.3% of 1M keys), and an oracle error row would
+    # read as a tier A/B failure — autogrow keeps the oracle exact
+    ocap = 1 << (2 * nkeys - 1).bit_length()
+    oi = V1Instance(Config(cache_size=ocap, cache_autogrow_max=ocap * 8,
+                           hot_set_capacity=0, sweep_interval_ms=0),
+                    mesh=make_mesh())
+    try:
+        dps_oracle, oracle_outs = _drive(oi)
+        row["oracle_decisions_per_s"] = round(dps_oracle)
+        row["oracle_error_rows"] = _count_error_rows(oracle_outs)
+        row["ab_identical"] = tier_outs == oracle_outs
+        row["tier_vs_uncapped"] = round(
+            dps_tier / max(dps_oracle, 1e-9), 3)
+    finally:
+        oi.close()
+    return {"13_tiered_store": row}
+
+
+def _count_error_rows(outs) -> int:
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+
+    n = 0
+    for data in outs:
+        resp = pb.GetRateLimitsResp.FromString(data)
+        n += sum(1 for r in resp.responses if r.error)
+    return n
+
+
 #: section name → (callable, result row keys for skip/error reporting)
 _SECTIONS = {
     "lat_client": (_sec_lat_client,
@@ -1781,11 +1885,12 @@ _SECTIONS = {
     "cfg5": (_sec_cfg5, ["5_gregorian_churn"]),
     "pallas": (_sec_pallas, ["11_pallas_serving"]),
     "mesh": (_sec_mesh, ["12_mesh_global"]),
+    "tiered": (_sec_tiered, ["13_tiered_store"]),
 }
 
 #: device sections that each pay a fresh compile, in run order
 _SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot",
-                  "cfg5", "pallas", "mesh"]
+                  "cfg5", "pallas", "mesh", "tiered"]
 
 _WEDGED = False  # set when a section timeout + failed device probe
 #: parent's backend, captured BEFORE the device client is released —

@@ -682,9 +682,17 @@ class KeyAnalytics:
     def sketch_count(self, khash: int) -> int:
         """Thread-safe tracked-count read for one key hash (0 when
         untracked) — the hot-set promotion feed (instance.py ›
-        _count_toward_promotion)."""
+        _count_toward_promotion) and the tiered store's admission rank
+        (tiering.py)."""
         with self._mu:
             return self.sketch.count_of(khash)
+
+    def sketch_counts(self, khashes) -> List[int]:
+        """Batched :meth:`sketch_count` — ONE lock acquisition for a
+        probe window's worth of victim-candidate ranks (tiering.py ›
+        _pick_victim picks the coldest device row to evict)."""
+        with self._mu:
+            return [self.sketch.count_of(int(k)) for k in khashes]
 
     def stats(self) -> dict:
         with self._mu:

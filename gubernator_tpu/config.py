@@ -111,6 +111,9 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_STALL_THRESHOLD_S": "wave stall-watchdog threshold seconds; <=0 disables",
     "GUBER_STEP_DONATE": "0 disables donated (aliased) step buffers",
     "GUBER_STEP_IMPL": "device step implementation (xla/pallas)",
+    "GUBER_TIER_COLD": "1 enables the host cold tier behind the device table",
+    "GUBER_TIER_NATIVE": "0 forces the pure-python cold-store fallback",
+    "GUBER_TIER_PROMOTE": "sketch-rank admission threshold for cold->hot promotion",
     "GUBER_TLS_AUTO": "generate a self-signed TLS setup at startup",
     "GUBER_TLS_CA": "TLS CA bundle path",
     "GUBER_TLS_CERT": "TLS server certificate path",
@@ -286,6 +289,16 @@ class Config:
     hot_set_capacity: int = 1024
     #: GLOBAL hits on one key before it is promoted to the hot set.
     hot_promote_threshold: int = 64
+    #: Host cold tier behind the device table (ISSUE 10): a key that
+    #: misses (or overflows) the HBM-resident table is served EXACTLY
+    #: from host memory instead of erroring table_full, and migrates to
+    #: HBM only once its sketch rank clears tier_promote_threshold —
+    #: key cardinality scales far past the device cap while the hot
+    #: tier stays wave-sized.  GUBER_TIER_COLD overrides.
+    tier_cold: bool = False
+    #: Sketch-rank admission threshold for cold→hot promotion (see
+    #: tiering.py).  GUBER_TIER_PROMOTE overrides.
+    tier_promote_threshold: int = 8
     #: Local peer identity (set by the daemon).
     advertise_address: str = ""
 

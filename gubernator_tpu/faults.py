@@ -98,6 +98,14 @@ FAULT_POINTS = {
                "aborted tick loses nothing)",
     "snapshot": "instance._save_to_loader — before the Loader snapshot",
     "restore": "instance._load_from_loader — before the Loader restore",
+    "tier_promote": "TierController.promote — after the admissibility "
+                    "gate, before the cold row is written to the "
+                    "device table (error aborts the migration: the row "
+                    "stays cold, tier_migrations_aborted increments)",
+    "tier_demote": "TierController.demote — before the victim row is "
+                   "gathered off the device (error aborts the "
+                   "eviction: the row stays hot and the triggering "
+                   "promotion is abandoned)",
 }
 
 

@@ -173,6 +173,36 @@ class V1Instance:
         pool = getattr(engine, "wave_pool", None)
         if pool is not None:
             pool.metrics = self.metrics
+        # Tiered key store (ISSUE 10, tiering.py): host cold tier
+        # behind the device table with sketch-rank admission.  The
+        # controller binds as engine.tier; check_packed pre-masks and
+        # cold-serves through it.  Victim picks skip mesh-/hot-set-
+        # pinned keys: their device row is a replica-coherence home
+        # copy, and demoting it would fork state.
+        self._tier = None
+        tier_cold = os.environ.get("GUBER_TIER_COLD")
+        if (tier_cold == "1" if tier_cold is not None
+                else config.tier_cold):
+            from .tiering import TierController
+
+            thr = int(os.environ.get("GUBER_TIER_PROMOTE")
+                      or config.tier_promote_threshold)
+            rank_fn = (analytics.sketch_count
+                       if analytics is not None else None)
+            tap = None
+            if getattr(engine, "fused_tap", False) \
+                    and analytics is not None:
+                # fused engines tap on device and the device tap gates
+                # out invalid rows — cold rows ride the wave invalid,
+                # so the tier feeds their counts to the sketch itself
+                tap = analytics.tap_packed
+            self._tier = TierController(
+                engine, rank_fn=rank_fn, promote_threshold=thr,
+                metrics=self.metrics, recorder=self.recorder,
+                fault=self._fault_point,
+                skip_victim=self._tier_victim_pinned, tap=tap,
+                rank_batch=(analytics.sketch_counts
+                            if analytics is not None else None))
         self._peer_tls = peer_tls_creds
         # Datacenter-aware deployments route through a region picker
         # (region_picker.go); single-region uses the flat ring.
@@ -341,7 +371,16 @@ class V1Instance:
         # them back in so the snapshot is complete
         self._demote_all()
         self._mesh_demote_all()
-        self.loader.save(iter(items_from_arrays(self.engine.snapshot())))
+        arrays = self.engine.snapshot()
+        if self._tier is not None:
+            # cold-tier rows are first-class state: a snapshot covers
+            # BOTH tiers (restore re-adopts whatever the device table
+            # cannot hold — engine.restore's unplaced → tier path)
+            cold = self._tier.snapshot_arrays()
+            if cold is not None:
+                arrays = {f: np.concatenate([arrays[f], cold[f]])
+                          for f in arrays}
+        self.loader.save(iter(items_from_arrays(arrays)))
         self.dispatcher._obs_phase("snapshot", time.perf_counter() - t0)
 
     def _fault_point(self, point: str, tag: Optional[str] = None) -> None:
@@ -1417,8 +1456,11 @@ class V1Instance:
                     pins.append((proto, ik, self._seed_row(ik)))
             if pins:
                 ok = mge.pin_many(pins, now)
-                for (_p, ik, _s), good in zip(pins, ok):
-                    if not good:  # probe window full → sharded path
+                for (proto, ik, _s), good in zip(pins, ok):
+                    if good:
+                        self._seed_commit(ik)
+                    elif not self._mesh_admit(proto, ik, now):
+                        # window full, nothing colder → sharded path
                         mesh_mask = mesh_mask & (kh != np.uint64(ik))
 
         # Fused single-launch path (ISSUE 8): a fused engine serves the
@@ -2198,14 +2240,10 @@ class V1Instance:
             pending, self._promote_pending = self._promote_pending, []
         for req, kh in pending:
             hs = self._ensure_hotset()
-            with self._engine_mu:
-                found, cols = self.engine.gather_rows(
-                    np.array([kh], np.uint64))
-            seed = None
-            if found[0]:
-                seed = {f: int(cols[f][0])
-                        for f in ("remaining", "t_ms", "expire_at", "meta")}
-            hs.pin(req, kh, now, seed=seed)
+            # _seed_row also consults the cold tier: a key can be hot
+            # by sketch rank while its row is still cold-resident
+            if hs.pin(req, kh, now, seed=self._seed_row(kh)):
+                self._seed_commit(kh)
 
     def _demote(self, key_hash: int) -> None:
         """Migrate one hot key's merged state back into the sharded
@@ -2219,8 +2257,11 @@ class V1Instance:
         if row is not None:
             cols = {f: np.array([row[f]]) for f in row}
             with self._engine_mu:
-                self.engine.upsert_rows(np.array([key_hash], np.uint64),
-                                        cols)
+                placed = self.engine.upsert_rows(
+                    np.array([key_hash], np.uint64), cols)
+                if not placed and self._tier is not None:
+                    self._tier.put_row(key_hash,
+                                       {f: int(row[f]) for f in row})
         hs.unpin(key_hash)
 
     def _demote_all(self) -> None:
@@ -2239,11 +2280,17 @@ class V1Instance:
         rows = [(kh, hs.row_state(kh)) for kh in khs]
         rows = [(kh, r) for kh, r in rows if r is not None]
         if rows:
+            karr = np.array([kh for kh, _ in rows], np.uint64)
             cols = {f: np.array([r[f] for _, r in rows])
                     for f in rows[0][1]}
             with self._engine_mu:
-                self.engine.upsert_rows(
-                    np.array([kh for kh, _ in rows], np.uint64), cols)
+                placed = self.engine.upsert_rows(karr, cols)
+                if placed < len(rows) and self._tier is not None:
+                    found, _ = self.engine.gather_rows(karr)
+                    for j, (kh, r) in enumerate(rows):
+                        if not found[j]:
+                            self._tier.put_row(
+                                kh, {f: int(r[f]) for f in r})
         for kh in khs:
             hs.unpin(kh)
 
@@ -2324,14 +2371,31 @@ class V1Instance:
 
     def _seed_row(self, kh: int) -> Optional[dict]:
         """The key's sharded-table row, for pin seeding (promotion into
-        the mesh tier must not forget hits already consumed)."""
+        the mesh tier must not forget hits already consumed).  With the
+        tiered store the key may live in the COLD tier instead: seed
+        from that row too.  Callers that pin successfully MUST follow
+        with ``_seed_commit(kh)`` — a lingering cold copy would shadow
+        the demoted row after the pin retires."""
         with self._engine_mu:
             found, cols = self.engine.gather_rows(
                 np.array([kh], np.uint64))
+            if not found[0] and self._tier is not None:
+                cold = self._tier.peek_row(kh)
+                if cold is not None:
+                    return {f: cold[f]
+                            for f in ("remaining", "t_ms", "expire_at",
+                                      "meta")}
         if not found[0]:
             return None
         return {f: int(cols[f][0])
                 for f in ("remaining", "t_ms", "expire_at", "meta")}
+
+    def _seed_commit(self, kh: int) -> None:
+        """Post-pin half of ``_seed_row``: the replica tier took
+        ownership of the key's state, so drop the cold-tier copy (a
+        no-op when the key wasn't cold-resident)."""
+        if self._tier is not None:
+            self._tier.pop_row(kh)
 
     def _mesh_route(self, req: RateLimitRequest, mesh_list, i,
                     now: int) -> bool:
@@ -2350,10 +2414,51 @@ class V1Instance:
             return True
         if not qualifies:
             return False
-        if not mge.pin(req, kh, now, seed=self._seed_row(kh)):
-            return False  # probe window full: sharded path is correct
+        if not self._mesh_admit(req, kh, now):
+            return False  # window full, nothing colder: sharded path
         mesh_list.append((i, kh))
         return True
+
+    def _mesh_admit(self, req: RateLimitRequest, kh: int,
+                    now: int) -> bool:
+        """Pin ``kh`` into the mesh tier under the overflow admission
+        policy: when the key's probe window is full, the coldest pinned
+        occupant (by sketch rank) is demoted — through the exact
+        stand-down migration path, so no hit is lost — and the pin
+        retried, provided the newcomer ranks strictly hotter.  Cap
+        overflow becomes a migration, not a silent fallback."""
+        mge = self._ensure_meshglobal()
+        if mge.pin(req, kh, now, seed=self._seed_row(kh)):
+            self._seed_commit(kh)
+            return True
+        victim = self._mesh_overflow_victim(kh)
+        if victim is None:
+            return False
+        self._mesh_demote(victim)
+        self.recorder.record("mesh_overflow_demote", khash=victim,
+                             admitted=kh)
+        if not mge.pin(req, kh, now, seed=self._seed_row(kh)):
+            return False  # window changed underneath us: sharded path
+        self._seed_commit(kh)
+        return True
+
+    def _mesh_overflow_victim(self, kh: int) -> Optional[int]:
+        """The coldest pinned occupant of ``kh``'s probe window, or
+        None when the newcomer does not STRICTLY outrank anyone there
+        (overflow then declines and the sharded/tiered path — always
+        exact — keeps serving the key)."""
+        if self.analytics is None or self._meshglobal is None:
+            return None
+        rank = self.analytics.sketch_count
+        best = None
+        best_rank = rank(kh)
+        for k in self._meshglobal.probe_occupants(kh):
+            if k == kh:
+                continue
+            r = rank(k)
+            if r < best_rank:
+                best, best_rank = k, r
+        return best
 
     def _mesh_demote(self, key_hash: int) -> None:
         """Migrate one mesh key's HOME-replica row back into the
@@ -2366,8 +2471,13 @@ class V1Instance:
         if row is not None:
             cols = {f: np.array([row[f]]) for f in row}
             with self._engine_mu:
-                self.engine.upsert_rows(
+                placed = self.engine.upsert_rows(
                     np.array([key_hash], np.uint64), cols)
+                if not placed and self._tier is not None:
+                    # device table full: the row lands in the cold tier
+                    # instead of being silently dropped
+                    self._tier.put_row(key_hash,
+                                       {f: int(row[f]) for f in row})
         mge.unpin(key_hash)
 
     def _mesh_demote_all(self) -> None:
@@ -2384,11 +2494,19 @@ class V1Instance:
         rows = [(kh, mge.row_state(kh)) for kh in khs]
         rows = [(kh, r) for kh, r in rows if r is not None]
         if rows:
+            karr = np.array([kh for kh, _ in rows], np.uint64)
             cols = {f: np.array([r[f] for _, r in rows])
                     for f in rows[0][1]}
             with self._engine_mu:
-                self.engine.upsert_rows(
-                    np.array([kh for kh, _ in rows], np.uint64), cols)
+                placed = self.engine.upsert_rows(karr, cols)
+                if placed < len(rows) and self._tier is not None:
+                    # some rows found no device slot: adopt them into
+                    # the cold tier (exact — nothing silently dropped)
+                    found, _ = self.engine.gather_rows(karr)
+                    for j, (kh, r) in enumerate(rows):
+                        if not found[j]:
+                            self._tier.put_row(
+                                kh, {f: int(r[f]) for f in r})
         for kh in khs:
             mge.unpin(kh)
 
@@ -2717,9 +2835,22 @@ class V1Instance:
             self._mesh_demote(kh)
         with self._engine_mu:
             n = self.engine.remove_rows(np.array([kh], np.uint64))
+            if self._tier is not None \
+                    and self._tier.pop_row(kh) is not None:
+                n += 1  # cold-resident: the row lived in the cold tier
         if self.store is not None:
             self.store.remove(f"{name}_{unique_key}")
         return n > 0
+
+    def _tier_victim_pinned(self, kh: int) -> bool:
+        """Tier-eviction victim filter: a replica-pinned key's device
+        row is the HOME copy of hot-set/mesh coherence — demoting it
+        to the cold tier while the pin serves would fork its state."""
+        hs = self._hotset
+        if hs is not None and hs.is_pinned(kh):
+            return True
+        mge = self._meshglobal
+        return mge is not None and mge.is_pinned(kh)
 
     def engine_occupancy(self) -> int:
         # the engine owns its table layout (SoA columns vs the pallas

@@ -303,6 +303,33 @@ class Metrics:
             "gubernator_mesh_global_keys",
             "keys currently pinned in the mesh-GLOBAL replica table",
             registry=r)
+        # Tiered key store (ISSUE 10): the hot/cold split only works if
+        # its migration traffic is visible — a thrashing admission
+        # policy or a cold tier absorbing most serves is a perf cliff
+        # that decision latency alone won't attribute.
+        self.tier_cold_keys = Gauge(
+            "gubernator_tier_cold_keys",
+            "keys resident in the host cold tier (device-table misses "
+            "served exactly from host memory)", registry=r)
+        self.tier_cold_serves = Counter(
+            "gubernator_tier_cold_serves",
+            "requests served from the host cold tier (device miss or "
+            "table overflow; byte-exact with the device step)",
+            registry=r)
+        self.tier_promotions = Counter(
+            "gubernator_tier_promotions",
+            "cold rows migrated into the device table after their "
+            "sketch rank cleared GUBER_TIER_PROMOTE", registry=r)
+        self.tier_demotions = Counter(
+            "gubernator_tier_demotions",
+            "device rows evicted to the host cold tier (promotion "
+            "victims and table-full writebacks; created_at-preserving, "
+            "conservation-exact)", registry=r)
+        self.tier_migrations_aborted = Counter(
+            "gubernator_tier_migrations_aborted",
+            "tier migrations abandoned at the tier_promote/tier_demote "
+            "faultpoints (the row stays in its source tier — no state "
+            "is lost)", registry=r)
 
     @contextmanager
     def time_func(self, name: str):

@@ -848,6 +848,236 @@ static PyObject* build_responses_from_columns(PyObject*, PyObject* args) {
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// Cold-tier key store (tiering.py): open-addressed khash u64 -> packed
+// 8x int64 bucket-state row (store.py column order minus the key).
+// Linear probing over a power-of-two table with tombstone deletes and
+// 0.7-load growth — the native backing for the host cold tier, so a
+// 100M-key residency costs ~72 B/key flat instead of a Python dict of
+// tuples.  NOT internally locked: the contract (documented on the
+// tiering.py wrappers, soaked by tools/native_soak.py) is that the
+// caller serializes mutations (TierController._mu).
+static const Py_ssize_t COLD_ROW = 8;  // int64 values per row
+
+struct ColdStore {
+  std::vector<uint64_t> keys;
+  std::vector<int64_t> rows;   // cap * COLD_ROW
+  std::vector<uint8_t> state;  // 0 empty, 1 full, 2 tombstone
+  size_t cap = 0;              // power of two
+  size_t used = 0;             // full slots
+  size_t filled = 0;           // full + tombstone (load-factor basis)
+};
+
+static const char* COLD_CAPSULE = "guber.cold_store";
+
+static void cold_destroy(PyObject* capsule) {
+  delete (ColdStore*)PyCapsule_GetPointer(capsule, COLD_CAPSULE);
+}
+
+static ColdStore* cold_from(PyObject* obj) {
+  return (ColdStore*)PyCapsule_GetPointer(obj, COLD_CAPSULE);
+}
+
+static void cold_init(ColdStore* cs, size_t cap) {
+  cs->cap = cap;
+  cs->used = cs->filled = 0;
+  cs->keys.assign(cap, 0);
+  cs->rows.assign(cap * COLD_ROW, 0);
+  cs->state.assign(cap, 0);
+}
+
+// Slot of `key`, or the first insertable slot (tombstone/empty) when
+// absent.  cap is power-of-two so the linear probe visits every slot.
+static size_t cold_find(const ColdStore* cs, uint64_t key, bool* present) {
+  size_t mask = cs->cap - 1;
+  size_t i = (size_t)key & mask;
+  size_t first_free = (size_t)-1;
+  for (size_t n = 0; n < cs->cap; n++, i = (i + 1) & mask) {
+    uint8_t st = cs->state[i];
+    if (st == 1 && cs->keys[i] == key) {
+      *present = true;
+      return i;
+    }
+    if (st == 2) {
+      if (first_free == (size_t)-1) first_free = i;
+      continue;
+    }
+    if (st == 0) {
+      *present = false;
+      return first_free != (size_t)-1 ? first_free : i;
+    }
+  }
+  *present = false;
+  return first_free;  // table of pure full+tombstone: growth precedes this
+}
+
+static void cold_grow(ColdStore* cs, size_t new_cap) {
+  ColdStore next;
+  cold_init(&next, new_cap);
+  for (size_t i = 0; i < cs->cap; i++) {
+    if (cs->state[i] != 1) continue;
+    bool present;
+    size_t j = cold_find(&next, cs->keys[i], &present);
+    next.keys[j] = cs->keys[i];
+    std::memcpy(&next.rows[j * COLD_ROW], &cs->rows[i * COLD_ROW],
+                COLD_ROW * sizeof(int64_t));
+    next.state[j] = 1;
+  }
+  next.used = next.filled = cs->used;
+  *cs = std::move(next);
+}
+
+// cold_new(cap_hint) -> capsule
+static PyObject* cold_new(PyObject*, PyObject* args) {
+  Py_ssize_t hint = 0;
+  if (!PyArg_ParseTuple(args, "|n", &hint)) return nullptr;
+  size_t cap = 64;
+  while ((Py_ssize_t)cap < hint) cap <<= 1;
+  ColdStore* cs = new ColdStore();
+  cold_init(cs, cap);
+  PyObject* capsule = PyCapsule_New(cs, COLD_CAPSULE, cold_destroy);
+  if (capsule == nullptr) delete cs;
+  return capsule;
+}
+
+// cold_put(capsule, key u64, row 64 bytes) -> 1 inserted / 0 overwrote
+static PyObject* cold_put(PyObject*, PyObject* args) {
+  PyObject* obj;
+  unsigned long long key;
+  Py_buffer row;
+  if (!PyArg_ParseTuple(args, "OKy*", &obj, &key, &row)) return nullptr;
+  ColdStore* cs = cold_from(obj);
+  if (cs == nullptr || row.len != COLD_ROW * (Py_ssize_t)sizeof(int64_t)) {
+    if (cs != nullptr)
+      PyErr_SetString(PyExc_ValueError, "cold row must be 64 bytes");
+    PyBuffer_Release(&row);
+    return nullptr;
+  }
+  if ((cs->filled + 1) * 10 >= cs->cap * 7)
+    // mostly-live table doubles; mostly-tombstones rehashes in place
+    cold_grow(cs, (cs->used + 1) * 10 >= cs->cap * 5 ? cs->cap * 2
+                                                     : cs->cap);
+  bool present;
+  size_t i = cold_find(cs, (uint64_t)key, &present);
+  if (!present) {
+    if (cs->state[i] == 0) cs->filled++;
+    cs->keys[i] = (uint64_t)key;
+    cs->state[i] = 1;
+    cs->used++;
+  }
+  std::memcpy(&cs->rows[i * COLD_ROW], row.buf,
+              COLD_ROW * sizeof(int64_t));
+  PyBuffer_Release(&row);
+  return PyLong_FromLong(present ? 0 : 1);
+}
+
+// cold_get(capsule, key u64) -> bytes(64) | None
+static PyObject* cold_get(PyObject*, PyObject* args) {
+  PyObject* obj;
+  unsigned long long key;
+  if (!PyArg_ParseTuple(args, "OK", &obj, &key)) return nullptr;
+  ColdStore* cs = cold_from(obj);
+  if (cs == nullptr) return nullptr;
+  bool present;
+  size_t i = cold_find(cs, (uint64_t)key, &present);
+  if (!present) Py_RETURN_NONE;
+  return PyBytes_FromStringAndSize((const char*)&cs->rows[i * COLD_ROW],
+                                   COLD_ROW * sizeof(int64_t));
+}
+
+// cold_pop(capsule, key u64) -> bytes(64) | None
+static PyObject* cold_pop(PyObject*, PyObject* args) {
+  PyObject* obj;
+  unsigned long long key;
+  if (!PyArg_ParseTuple(args, "OK", &obj, &key)) return nullptr;
+  ColdStore* cs = cold_from(obj);
+  if (cs == nullptr) return nullptr;
+  bool present;
+  size_t i = cold_find(cs, (uint64_t)key, &present);
+  if (!present) Py_RETURN_NONE;
+  PyObject* out = PyBytes_FromStringAndSize(
+      (const char*)&cs->rows[i * COLD_ROW], COLD_ROW * sizeof(int64_t));
+  if (out != nullptr) {
+    cs->state[i] = 2;  // tombstone keeps later probe chains intact
+    cs->used--;
+  }
+  return out;
+}
+
+// cold_len(capsule) -> resident key count
+static PyObject* cold_len(PyObject*, PyObject* args) {
+  PyObject* obj;
+  if (!PyArg_ParseTuple(args, "O", &obj)) return nullptr;
+  ColdStore* cs = cold_from(obj);
+  if (cs == nullptr) return nullptr;
+  return PyLong_FromSize_t(cs->used);
+}
+
+// cold_contains(capsule, keys u64le bytes, out u8 writable) -> None
+// The engine pre-mask read: one call per wave, no per-key Python.
+static PyObject* cold_contains(PyObject*, PyObject* args) {
+  PyObject* obj;
+  Py_buffer keys, out;
+  if (!PyArg_ParseTuple(args, "Oy*w*", &obj, &keys, &out)) return nullptr;
+  ColdStore* cs = cold_from(obj);
+  Py_ssize_t n = keys.len / 8;
+  if (cs == nullptr || out.len < n) {
+    if (cs != nullptr)
+      PyErr_SetString(PyExc_ValueError, "output mask too short");
+    PyBuffer_Release(&keys);
+    PyBuffer_Release(&out);
+    return nullptr;
+  }
+  const uint64_t* kp = (const uint64_t*)keys.buf;
+  uint8_t* op = (uint8_t*)out.buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    bool present;
+    cold_find(cs, kp[i], &present);
+    op[i] = present ? 1 : 0;
+  }
+  PyBuffer_Release(&keys);
+  PyBuffer_Release(&out);
+  Py_RETURN_NONE;
+}
+
+// cold_snapshot(capsule) -> (n, keys u64le bytes, rows i64le bytes)
+static PyObject* cold_snapshot(PyObject*, PyObject* args) {
+  PyObject* obj;
+  if (!PyArg_ParseTuple(args, "O", &obj)) return nullptr;
+  ColdStore* cs = cold_from(obj);
+  if (cs == nullptr) return nullptr;
+  Py_ssize_t n = (Py_ssize_t)cs->used;
+  PyObject* kb = PyBytes_FromStringAndSize(nullptr, n * 8);
+  PyObject* rb =
+      PyBytes_FromStringAndSize(nullptr, n * COLD_ROW * sizeof(int64_t));
+  if (kb == nullptr || rb == nullptr) {
+    Py_XDECREF(kb);
+    Py_XDECREF(rb);
+    return nullptr;
+  }
+  uint64_t* kp = (uint64_t*)PyBytes_AS_STRING(kb);
+  int64_t* rp = (int64_t*)PyBytes_AS_STRING(rb);
+  Py_ssize_t w = 0;
+  for (size_t i = 0; i < cs->cap; i++) {
+    if (cs->state[i] != 1) continue;
+    kp[w] = cs->keys[i];
+    std::memcpy(&rp[w * COLD_ROW], &cs->rows[i * COLD_ROW],
+                COLD_ROW * sizeof(int64_t));
+    w++;
+  }
+  return Py_BuildValue("(nNN)", w, kb, rb);
+}
+
+// cold_clear(capsule) -> None
+static PyObject* cold_clear(PyObject*, PyObject* args) {
+  PyObject* obj;
+  if (!PyArg_ParseTuple(args, "O", &obj)) return nullptr;
+  ColdStore* cs = cold_from(obj);
+  if (cs == nullptr) return nullptr;
+  cold_init(cs, 64);
+  Py_RETURN_NONE;
+}
+
 static PyMethodDef methods[] = {
     {"fnv1a64_batch", fnv1a64_batch, METH_O,
      "Batch raw FNV-1a64 of str/bytes -> (le64 bytes, n)"},
@@ -871,6 +1101,23 @@ static PyMethodDef methods[] = {
      METH_VARARGS,
      "Rows [lo, hi) of shared result columns -> GetRateLimitsResp "
      "wire bytes"},
+    {"cold_new", cold_new, METH_VARARGS,
+     "Cold-tier store (tiering.py): new open-addressed khash->row "
+     "table -> capsule"},
+    {"cold_put", cold_put, METH_VARARGS,
+     "cold_put(capsule, key, row64B) -> 1 inserted / 0 overwrote"},
+    {"cold_get", cold_get, METH_VARARGS,
+     "cold_get(capsule, key) -> 64-byte row | None"},
+    {"cold_pop", cold_pop, METH_VARARGS,
+     "cold_pop(capsule, key) -> 64-byte row | None (tombstone delete)"},
+    {"cold_len", cold_len, METH_VARARGS,
+     "cold_len(capsule) -> resident key count"},
+    {"cold_contains", cold_contains, METH_VARARGS,
+     "cold_contains(capsule, keys u64le, out u8) -> membership mask"},
+    {"cold_snapshot", cold_snapshot, METH_VARARGS,
+     "cold_snapshot(capsule) -> (n, keys bytes, rows bytes)"},
+    {"cold_clear", cold_clear, METH_VARARGS,
+     "cold_clear(capsule) -> reset to empty"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native",

@@ -220,6 +220,14 @@ class MeshGlobalEngine:
     def matches_pinned(self, key_hash: int, req: RateLimitRequest) -> bool:
         return self.pinned_cfg.get(key_hash) == _cfg_of(req)
 
+    def probe_occupants(self, key_hash: int) -> List[int]:
+        """Pinned keys whose slots occupy ``key_hash``'s probe window —
+        the overflow-admission read: a cap overflow demotes the coldest
+        of these (by sketch rank) instead of silently declining."""
+        with self._mu:
+            window = set(self._probe_slots_host(key_hash))
+            return [k for k, s in self.slots.items() if s in window]
+
     def pin_many(self, entries: Sequence[tuple], now_ms: int) -> List[bool]:
         """Pin several keys in ONE device upload set.  ``entries``:
         (req, key_hash, seed-or-None) — seed carries the key's sharded

@@ -609,9 +609,25 @@ class PallasServingEngine(FusedServingMixin, ShardedEngine):
     def check_packed(self, batch, khash, now_ms: int,
                      mslot=None) -> tuple:
         batch, ood = self._mask_out_of_domain(batch, mslot)
-        return self._merge_ood(
+        cols = self._merge_ood(
             super().check_packed(batch, khash, now_ms, mslot=mslot),
             ood)
+        tier = self.tier
+        if tier is None or ood is None:
+            return cols
+        # tiered store: the kernel can't serve out-of-domain values but
+        # the host cold tier can — exactly.  Only keys with NO device
+        # row are eligible (cold-serving a device-resident key would
+        # fork its state); the rest keep the table_full error.
+        kh = np.asarray(khash)
+        found, _ = self.gather_rows(kh[ood])
+        elig = ood[~found]
+        if not len(elig):
+            return cols
+        need = np.zeros(len(kh), bool)
+        need[elig] = True
+        return tier.resolve(self, batch, khash, now_ms, cols,
+                            None, need, mslot=mslot)
 
     def launch_packed(self, batch, khash, now_ms: int, mslot=None):
         # the pipelined dispatcher path calls launch/sync directly —
@@ -632,6 +648,29 @@ class PallasServingEngine(FusedServingMixin, ShardedEngine):
         raise NotImplementedError(
             "pallas serving mode has no on-device grow; size rows up "
             "front (bucket-full rows err as table_full)")
+
+    # ---- tiered store hooks (tiering.py) -------------------------------
+
+    def tier_row_admissible(self, row) -> bool:
+        """Admission domain gate: a cold row whose values exceed the
+        kernel's packed-word domain must STAY cold — upsert_rows would
+        silently drop it, and the migration would lose the row."""
+        cols = {f: np.array([v], np.int64) for f, v in zip(
+            ("meta", "limit", "duration", "eff_ms", "burst",
+             "remaining", "t_ms", "expire_at"), row)}
+        cols["meta"] = cols["meta"].astype(np.int32)
+        _, valid = _columns_to_words_batch(cols, np.array([1], np.uint64))
+        return bool(valid[0])
+
+    def probe_occupant_keys(self, kh: int) -> np.ndarray:
+        """Eviction-candidate read for the tier controller: the
+        bucketized layout's probe window IS the key's bucket, so the
+        occupants are the bucket's resident keys (0 = free slot)."""
+        b = self._fetch_buckets(
+            self._bucket_indices(np.array([kh], np.uint64)))[0]
+        lo = b[:, ps.W_KLO].astype(np.uint64) & np.uint64(0xFFFFFFFF)
+        hi = b[:, ps.W_KHI].astype(np.uint64) & np.uint64(0xFFFFFFFF)
+        return (hi << np.uint64(32)) | lo
 
     # ---- sweep ---------------------------------------------------------
 

@@ -360,6 +360,11 @@ class ShardedEngine:
         #: dispatch).  V1Instance binds its Metrics here for the
         #: hit/miss/leak counters.
         self.wave_pool = WaveBufferPool()
+        #: bound TierController (tiering.py) when GUBER_TIER_COLD=1 —
+        #: check_packed pre-masks cold-resident rows out of the device
+        #: wave and serves them (plus residual table-full rows) from
+        #: the host cold tier on the way out
+        self.tier = None  # lock-free: set once at instance wiring, read-only after
 
     def _init_table_and_step(self) -> None:
         """Build self.state + self._step (subclass hook: the Pallas
@@ -522,7 +527,25 @@ class ShardedEngine:
         Returns an opaque token for ``sync_packed``.  State threads
         through the launches, so later launches are ordered after these
         device-side regardless of when anyone syncs.  ``mslot`` rides
-        the token so the sync-side retry keeps the rows' lanes."""
+        the token so the sync-side retry keeps the rows' lanes.
+
+        Cold-tier rows (tiering.py) ride the wave invalid and their
+        indices ride the token: the SYNC side re-dispatches them
+        through check_packed under the engine lock — serving them here
+        would let a promotion that lands between launch and sync read
+        a row this lane already consumed."""
+        tier = self.tier
+        cold_idx = None
+        if tier is not None:
+            kh = np.asarray(khash)
+            ov = np.asarray(batch.valid) & (kh != 0)
+            cm = tier.resident_mask(kh) & ov
+            if mslot is not None:
+                cm &= np.asarray(mslot) < 0
+            if cm.any():
+                cold_idx = np.nonzero(cm)[0]
+                batch = batch._replace(
+                    valid=np.asarray(batch.valid) & ~cm)
         pending = self._arrival_order(batch)
         launched = []
         for idx, slots, bw_w in self._build_waves(khash, pending):
@@ -538,7 +561,7 @@ class ShardedEngine:
             finally:
                 lease.release()  # the launch copied the host operands
             launched.append((idx, slots, packed, counters))
-        return (batch, khash, now_ms, launched, mslot)
+        return (batch, khash, now_ms, launched, mslot, cold_idx)
 
     def sync_packed(self, token, engine_lock=None) -> tuple:
         """Pipeline phase 2: block on the launched waves and assemble
@@ -550,7 +573,7 @@ class ShardedEngine:
         acceptable: erred rows never mutated state, retries are the
         table-full corner, and the device clamps per-key time
         monotonically."""
-        batch, khash, now_ms, launched, mslot = token
+        batch, khash, now_ms, launched, mslot, cold_idx = token
         n = len(khash)
         status = np.zeros(n, np.int32)
         rem_o = np.zeros(n, np.int64)
@@ -583,6 +606,26 @@ class ShardedEngine:
             rem_o[ei] = r_rem
             rst_o[ei] = r_rst
             full[ei] = r_full
+        if cold_idx is not None and len(cold_idx):
+            import contextlib
+
+            # cold-tier rows rode the waves invalid (see launch_packed):
+            # re-dispatch just them through check_packed, which serves
+            # from whichever tier the key is in NOW — exact even when a
+            # promotion landed between our launch and this sync
+            ci = np.asarray(cold_idx)
+            sub = type(batch)(*[np.asarray(c)[ci] for c in batch])
+            sub = sub._replace(valid=np.ones(len(ci), bool))
+            msub = None if mslot is None else np.asarray(mslot)[ci]
+            with (engine_lock if engine_lock is not None
+                  else contextlib.nullcontext()):
+                c_st, c_lim, c_rem, c_rst, c_full = self.check_packed(
+                    sub, khash[ci], now_ms, mslot=msub)
+            status[ci] = c_st
+            lim_o[ci] = c_lim
+            rem_o[ci] = c_rem
+            rst_o[ci] = c_rst
+            full[ci] = c_full
         return status, lim_o, rem_o, rst_o, full
 
     def warmup(self, now_ms: int = 1) -> None:
@@ -682,6 +725,19 @@ class ShardedEngine:
         check_packed is the same recovery check_batch performs)."""
         n = pre.n
         lease = pre.lease
+        # cold-tier rows (tiering.py) must not reach the device insert:
+        # zero their valid flag in the leased matrices, then route them
+        # through the same check_packed rebuild the table-full retry
+        # uses (check_packed serves them from the cold tier)
+        tier = self.tier
+        cold_i = None
+        if tier is not None:
+            kh_n = np.asarray(pre.khash[:n], np.uint64)
+            cm = (tier.resident_mask(kh_n) & (kh_n != 0)
+                  & (lease.a32[2][:n] != 0))
+            if cm.any():
+                cold_i = np.nonzero(cm)[0]
+                lease.a32[2][cold_i] = 0
         try:
             # retry needs the request columns; snapshot them from the
             # lease ONLY if the cheap error scan demands it (below)
@@ -689,17 +745,20 @@ class ShardedEngine:
             o_st, o_rem, o_rst, o_lim, o_err = self._finish_wave(
                 *launched)
             err = o_err[:n]
-            if not err.any():
+            if not err.any() and cold_i is None:
                 lease.release()
                 lease = None
                 return (o_st[:n].astype(np.int32), o_lim[:n], o_rem[:n],
                         o_rst[:n], err)
-            # rare path: probe windows exhausted — rebuild the erred
-            # rows as a RequestBatch from the still-leased matrices and
-            # push them through check_packed (sweep-retry/auto-grow
-            # live there; non-erred rows already applied, so only the
-            # erred subset re-runs)
+            # rare path: probe windows exhausted (or cold-tier rows) —
+            # rebuild those rows as a RequestBatch from the still-leased
+            # matrices and push them through check_packed (sweep-retry/
+            # auto-grow/cold serve live there; non-erred rows already
+            # applied, so only this subset re-runs)
             ei = np.nonzero(err)[0]
+            if cold_i is not None:
+                lease.a32[2][cold_i] = 1  # restore valid for the rebuild
+                ei = np.unique(np.concatenate([ei, cold_i]))
             a64, a32 = lease.a64, lease.a32
             sub = RequestBatch(
                 key=a64[0][ei].view(np.uint64),
@@ -717,7 +776,8 @@ class ShardedEngine:
             rem_o = o_rem[:n].copy()
             rst_o = o_rst[:n].copy()
             full = np.zeros(n, bool)
-            self.sweep(now_ms)
+            if err.any():  # cold-only subsets skip the expiry sweep
+                self.sweep(now_ms)
             r_st, r_lim, r_rem, r_rst, r_full = self.check_packed(
                 sub, khash_sub, now_ms)
             status[ei] = r_st
@@ -764,6 +824,23 @@ class ShardedEngine:
         rst_o = np.zeros(n, np.int64)
         lim_o = np.zeros(n, np.int64)
         full = np.zeros(n, bool)
+        # tiered store (tiering.py): cold-resident rows must NOT hit
+        # the device table (a non-full table would insert them fresh —
+        # a state fork); ride the wave invalid and serve from the cold
+        # tier in the resolve below.  Mesh-pinned rows (mslot >= 0) are
+        # never cold: the pin seed pops the cold copy.
+        tier = self.tier
+        cold_mask = None
+        orig_valid = None
+        if tier is not None:
+            kh = np.asarray(khash)
+            orig_valid = np.asarray(batch.valid) & (kh != 0)
+            cold_mask = tier.resident_mask(kh) & orig_valid
+            if mslot is not None:
+                cold_mask &= np.asarray(mslot) < 0
+            if cold_mask.any():
+                batch = batch._replace(
+                    valid=np.asarray(batch.valid) & ~cold_mask)
         # earliest requests take the earliest waves: same-key requests
         # split across waves then apply in arrival-time order (within a
         # wave the device's (row, now) sort handles it)
@@ -807,6 +884,13 @@ class ShardedEngine:
                     rst_o[i] = 0
                     lim_o[i] = 0
                 pending = np.empty(0, np.int64)
+        if tier is not None:
+            # cold lane: pre-masked cold-resident rows plus residual
+            # table-full rows (brand-new keys, device table saturated —
+            # the tier turns table-full into find-or-create on host)
+            return tier.resolve(self, batch, khash, now_ms,
+                                (status, lim_o, rem_o, rst_o, full),
+                                cold_mask, orig_valid, mslot=mslot)
         return status, lim_o, rem_o, rst_o, full
 
     def _try_auto_grow(self, grew: list) -> bool:
@@ -932,6 +1016,25 @@ class ShardedEngine:
 
         return int(occupancy(self.state))
 
+    def probe_occupant_keys(self, kh: int) -> np.ndarray:
+        """The resident key hashes in ``kh``'s probe window (up to
+        PROBES entries, 0 = free slot) — the tier controller's eviction
+        candidate read: any of these keys, once demoted, frees a slot
+        ``kh`` itself can take (same probe formula as the device kernel,
+        core/step.py › _probe_slots)."""
+        from ..core.step import PROBES
+
+        k = np.uint64(kh)
+        stride = (k >> np.uint64(17)) | np.uint64(1)
+        local = ((k + np.arange(PROBES, dtype=np.uint64) * stride)
+                 & np.uint64(self.cap_local - 1))
+        shard = int(shard_of(np.array([k], np.uint64), self.n)[0])
+        slots = (shard * self.cap_local + local).astype(np.int64)
+        with XLA_EXEC_MU:
+            keys = np.asarray(
+                jnp.take(self.state.key, jnp.asarray(slots), axis=0))
+        return keys.astype(np.uint64)
+
     def each(self):
         """Iterate live rows as store.CacheItem objects (Cache.Each
         analog) — a host-side snapshot walk, for admin/debug tooling."""
@@ -967,6 +1070,7 @@ class ShardedEngine:
         shard = shard_of(keys, self.n)
         stride = (keys >> np.uint64(17)) | np.uint64(1)
         placed = 0
+        unplaced: List[int] = []
         for i in range(len(keys)):
             base = int(shard[i]) * cap
             k = keys[i]
@@ -980,6 +1084,13 @@ class ShardedEngine:
                     host["key"][slot] = k
                     placed += 1
                     break
+            else:
+                unplaced.append(i)
+        if unplaced and self.tier is not None:
+            # tiered restore: rows the device table can't hold land in
+            # the cold tier instead of being dropped — the snapshot
+            # round-trip keeps every row in exactly one tier
+            placed += self.tier.adopt_rows(arrays, unplaced)
         sh = table_sharding(self.mesh)
         from ..core.table import TableState, init_table
 

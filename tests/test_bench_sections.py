@@ -93,6 +93,32 @@ def test_mesh_global_section_child_writes_row(tmp_path):
     assert r["reconcile_generations"] >= 1
 
 
+def test_tiered_section_child_writes_row(tmp_path):
+    """The 13_tiered_store row (ISSUE 10) through the driver's real
+    child protocol: a device cap far below the key domain served
+    through the host cold tier.  The verdict columns ARE the acceptance
+    criteria — zero error rows on both sides, conservation exact across
+    both tiers, decisions byte-identical to the uncapped oracle — with
+    the capacity/migration story alongside."""
+    rows = _run_section("tiered", tmp_path, timeout=600)
+    r = rows["13_tiered_store"]
+    assert r["device_cap_rows"] == 4096
+    assert r["key_domain"] > r["device_cap_rows"]
+    assert r["decisions_per_s"] > 0
+    assert r["oracle_decisions_per_s"] > 0
+    assert r["error_rows"] == 0
+    assert r["oracle_error_rows"] == 0
+    assert r["conservation_exact"] is True
+    assert r["ab_identical"] is True
+    assert r["cold_keys"] > 0
+    assert r["cold_served"] > 0
+    assert r["promotions"] > 0
+    assert r["demotions"] == r["promotions"]
+    assert r["migrations_aborted"] == 0
+    assert 0 <= r["hot_hit_rate"] <= 1
+    assert "cold_store_native" in r and "tier_vs_uncapped" in r
+
+
 def test_section_registry_covers_baseline_rows():
     """Every BASELINE row key the orchestrator may need to error-fill
     is declared by exactly one section."""
@@ -105,7 +131,8 @@ def test_section_registry_covers_baseline_rows():
                 "4_global_sharded", "5_gregorian_churn",
                 "6_service_path", "7_hot_psum", "8_peer_path",
                 "9_clustered_service", "10_reuseport_group",
-                "11_pallas_serving", "12_mesh_global"]:
+                "11_pallas_serving", "12_mesh_global",
+                "13_tiered_store"]:
         assert row in declared, row
     for name in bench._SECTION_ORDER:
         assert name in bench._SECTIONS

@@ -111,15 +111,30 @@ class _Ctx:
         self.mesh = V1Instance(Config(
             global_mode="mesh",
             behaviors=BehaviorConfig(global_sync_wait_ms=50)))
+        # solo tiered instance (ISSUE 10): a device table capped far
+        # below the keyspace so the cold tier and its migration
+        # faultpoints (tier_promote / tier_demote) see real traffic
+        # (1024 rows is the engine's per-shard floor — hence n=1)
+        from gubernator_tpu.parallel import make_mesh
+
+        self.tier = V1Instance(Config(
+            cache_size=1024, cache_autogrow_max=1024, tier_cold=True,
+            tier_promote_threshold=2, behaviors=BehaviorConfig()),
+            mesh=make_mesh(n=1))
+        self.tier_hits = {}  # unique_key → hits issued (conservation)
+        self.tier_cell = 0  # fresh key namespace per driven cell
 
     def close(self):
         try:
-            self.mesh.close()
+            self.tier.close()
         finally:
             try:
-                self.solo.close()
+                self.mesh.close()
             finally:
-                self.c.stop()
+                try:
+                    self.solo.close()
+                finally:
+                    self.c.stop()
 
 
 def _classify_rows(data: bytes) -> str:
@@ -286,6 +301,60 @@ def _drive_restore(ctx: _Ctx) -> str:
     return "served"
 
 
+def _drive_tier(ctx: _Ctx) -> str:
+    """tier_promote / tier_demote (ISSUE 10): overflow the 1024-row
+    device table with a cell-fresh keyspace so keys land in the cold
+    tier, then hammer a band of cold keys past the admission
+    threshold — every promotion (and the demotion it triggers on the
+    full table) crosses the armed faultpoint.  An ERROR fault must
+    abort the migration cleanly: the row stays in its source tier and
+    serving continues without error rows."""
+    from gubernator_tpu.hashing import hash_key
+    from gubernator_tpu.types import RateLimitRequest
+
+    ctx.tier_cell += 1
+    ns = f"t{ctx.tier_cell}k"
+
+    def hit(key, hits=1):
+        ctx.tier_hits[key] = ctx.tier_hits.get(key, 0) + hits
+        return RateLimitRequest(name="chaos", unique_key=key, hits=hits,
+                                limit=10 ** 6, duration=DAY)
+
+    inst = ctx.tier
+    for base in range(0, 2048, 512):
+        out = inst.get_rate_limits(
+            [hit(f"{ns}{i}") for i in range(base, base + 512)],
+            now_ms=NOW0)
+        if any(r.error for r in out):
+            return "error_rows"
+    cold = [i for i in range(2048) if inst._tier.peek_row(
+        hash_key("chaos", f"{ns}{i}")) is not None][:8]
+    if not cold:
+        return "unexpected:no_cold_rows"
+    for _ in range(6):  # past the threshold → promote (+ demote)
+        out = inst.get_rate_limits([hit(f"{ns}{i}") for i in cold],
+                                   now_ms=NOW0)
+        if any(r.error for r in out):
+            return "error_rows"
+        time.sleep(0.1)  # let the async rank feed fold the wave
+    return "served"
+
+
+def _tier_probe(ctx: _Ctx) -> bool:
+    """Post-fault oracle for the tier cells: EXACT conservation across
+    every key ever driven, wherever its row now lives (device or cold,
+    including rows whose migration the fault aborted mid-flight)."""
+    from gubernator_tpu.types import RateLimitRequest
+
+    for k, n in ctx.tier_hits.items():
+        r = ctx.tier.get_rate_limits([RateLimitRequest(
+            name="chaos", unique_key=k, hits=0, limit=10 ** 6,
+            duration=DAY)], now_ms=NOW0)[0]
+        if r.error or r.remaining != 10 ** 6 - n:
+            return False
+    return True
+
+
 def _probe(ctx: _Ctx) -> bool:
     """Clean-path probe after clearing a fault: both a local and a
     forwarded row must serve without error rows."""
@@ -332,6 +401,10 @@ MATRIX = {
     "mr_sync": (_drive_mr, "cluster"),
     "snapshot": (_drive_snapshot, "solo"),
     "restore": (_drive_restore, "solo"),
+    # tiered key store (ISSUE 10): armed on the capped solo instance;
+    # the probe re-verifies exact conservation over every key driven
+    "tier_promote": (_drive_tier, "tier"),
+    "tier_demote": (_drive_tier, "tier"),
 }
 
 MODES = ("error", "delay")
@@ -348,8 +421,8 @@ def run_matrix(points=None, verbose=False) -> dict:
         for point, (driver, where) in MATRIX.items():
             if points and point not in points:
                 continue
-            inst = {"solo": ctx.solo, "mesh": ctx.mesh}.get(where,
-                                                            ctx.i0)
+            inst = {"solo": ctx.solo, "mesh": ctx.mesh,
+                    "tier": ctx.tier}.get(where, ctx.i0)
             for mode in MODES:
                 spec = (f"{point}:delay:5ms" if mode == "delay"
                         else f"{point}:error")
@@ -371,6 +444,8 @@ def run_matrix(points=None, verbose=False) -> dict:
                     recovered = _probe(ctx)
                 elif where == "mesh":
                     recovered = _mesh_probe(ctx)
+                elif where == "tier":
+                    recovered = _tier_probe(ctx)
                 else:
                     recovered = True
                 ok = (outcome != "hung"

@@ -127,12 +127,37 @@ def main() -> int:
 
     errs: list = []
     barrier = threading.Barrier(args.threads)
+    # cold-store contract (tiering.py): the table is NOT internally
+    # locked — TierController._mu serializes every access.  The soak
+    # mirrors that exactly: a shared store behind ONE lock (the
+    # sanitizer proves the external-locking discipline suffices) plus
+    # an unshared per-thread store hammered lock-free.
+    has_cold = hasattr(native, "cold_new")
+    shared_cold = native.cold_new(64) if has_cold else None
+    shared_cold_mu = threading.Lock()
+
+    def cold_churn(store, base: int, i: int, np) -> None:
+        row = np.arange(8, dtype="<i8") + i
+        for j in range(16):
+            kh = base + ((i * 16 + j) % 97) + 1
+            native.cold_put(store, kh, row.tobytes())
+            got = native.cold_get(store, kh)
+            assert got is not None and len(got) == 64
+            if j % 3 == 0:
+                native.cold_pop(store, kh)
+        keys = np.arange(base + 1, base + 33, dtype="<u8")
+        out = np.zeros(32, np.uint8)
+        native.cold_contains(store, keys.tobytes(), out)
+        n, kb, rb = native.cold_snapshot(store)
+        assert len(kb) == 8 * n and len(rb) == 64 * n
+        assert native.cold_len(store) == n
 
     def worker(t: int) -> None:
         try:
             m = 64
             a64 = np.zeros((8, m), np.int64)
             a32 = np.zeros((3, m), np.int32)
+            own_cold = native.cold_new(16) if has_cold else None
             barrier.wait(timeout=60)
             for i in range(args.iters):
                 # parse: read-only over the SHARED request bytes
@@ -164,6 +189,12 @@ def main() -> int:
                 buf, n = native.fnv1a64_pair_batch(
                     ["soak"] * 8, [f"k{j}" for j in range(8)])
                 assert n == 8
+                # cold-store churn: per-thread store lock-free, the
+                # shared store under the tier's external-lock contract
+                if has_cold:
+                    cold_churn(own_cold, t * 1_000_000, i, np)
+                    with shared_cold_mu:
+                        cold_churn(shared_cold, 77_000_000, i, np)
         except Exception as e:  # noqa: BLE001 - reported below
             errs.append(f"thread {t}: {e!r}")
 

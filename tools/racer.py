@@ -81,13 +81,20 @@ def one_req(hits, key, name):
         duration=DAY)])
 
 
-def fault_spec(rng: random.Random) -> str:
+def fault_spec(rng: random.Random, tier: bool = False) -> str:
     """Seed-derived preemption schedule: each dispatcher merge/carry/
     splice point sleeps a small seed-chosen time with a seed-chosen
     probability.  Delays are ms-scale — enough to push a concurrent
-    caller into the next wave, small enough that a run stays fast."""
+    caller into the next wave, small enough that a run stays fast.
+    ``tier`` adds the cold-tier migration points (ISSUE 10): a delayed
+    promote/demote widens the window in which a concurrent caller can
+    observe the key mid-migration — exactly the interleaving the tier's
+    engine-lock protocol must make invisible."""
+    points = ["dispatch_merge", "dispatch_carry", "dispatch_splice"]
+    if tier:
+        points += ["tier_promote", "tier_demote"]
     parts = []
-    for point in ("dispatch_merge", "dispatch_carry", "dispatch_splice"):
+    for point in points:
         delay_ms = rng.choice((1, 2, 3, 5))
         prob = rng.choice((0.2, 0.35, 0.5))
         parts.append(f"{point}:delay:{delay_ms}ms:{prob}")
@@ -95,7 +102,8 @@ def fault_spec(rng: random.Random) -> str:
 
 
 def run_once(seed: int, run_idx: int, threads: int, keys_n: int,
-             reps: int, hits: int, warm: bool, verbose: bool) -> dict:
+             reps: int, hits: int, warm: bool, verbose: bool,
+             tier: bool = False) -> dict:
     from gubernator_tpu import cluster as cluster_mod
     from gubernator_tpu.proto import gubernator_pb2 as pb
 
@@ -103,9 +111,34 @@ def run_once(seed: int, run_idx: int, threads: int, keys_n: int,
     tag = f"s{seed}r{run_idx}"
     name = f"racer-{tag}"
     keys = [f"racer-{tag}-k{i}" for i in range(keys_n)]
-    spec = fault_spec(rng)
-    c = cluster_mod.start(3)
+    spec = fault_spec(rng, tier=tier)
+    if tier:
+        # tiered mode (ISSUE 10): 1024-row tables (the per-shard
+        # floor, n=1 mesh) pre-filled past capacity so the racer's
+        # unwarmed keys land COLD — every hammered key then migrates
+        # cold→hot mid-race under the delayed migration points
+        from gubernator_tpu.parallel import make_mesh
+
+        c = cluster_mod.start(3, mesh=make_mesh(n=1),
+                              cache_size=1024,
+                              cache_autogrow_max=1024)
+    else:
+        c = cluster_mod.start(3)
     try:
+        if tier:
+            from gubernator_tpu.proto import gubernator_pb2 as _pb
+
+            for base in range(0, 5000, 500):
+                msg = _pb.GetRateLimitsReq()
+                for i in range(base, base + 500):
+                    m = msg.requests.add()
+                    m.name = name
+                    m.unique_key = f"racer-{tag}-fill{i}"
+                    m.hits = 0
+                    m.limit = LIMIT
+                    m.duration = DAY
+                c.instance_at(0).get_rate_limits_wire(
+                    msg.SerializeToString(), now_ms=NOW0)
         # warm each ENGINE with an unrelated key so the first wave's
         # compile cost doesn't serialize the whole schedule; the keys
         # under test stay COLD unless --warm asked for the control run
@@ -204,6 +237,10 @@ def main(argv=None) -> int:
                     help="disable caller-clock forwarding "
                          "(GUBER_CREATED_AT_FWD=0): reproduces the "
                          "pre-fix cold-key conservation loss")
+    ap.add_argument("--tier", action="store_true",
+                    help="tiered-store mode (ISSUE 10): capped tables "
+                         "+ cold tier, delayed tier_promote/"
+                         "tier_demote in the preemption schedule")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -211,10 +248,16 @@ def main(argv=None) -> int:
         os.environ["GUBER_CREATED_AT_FWD"] = "0"
         print("caller-clock forwarding DISABLED "
               "(GUBER_CREATED_AT_FWD=0): expecting the pre-fix loss")
+    if args.tier:
+        os.environ["GUBER_TIER_COLD"] = "1"
+        os.environ.setdefault("GUBER_TIER_PROMOTE", "2")
+        print("tiered store ENABLED (GUBER_TIER_COLD=1): capped "
+              "tables, racer keys start cold and migrate mid-race")
     failures = 0
     for i in range(args.runs):
         r = run_once(args.seed, i, args.threads, args.keys, args.reps,
-                     args.hits, args.warm, args.verbose)
+                     args.hits, args.warm, args.verbose,
+                     tier=args.tier)
         if r["ok"]:
             print(f"run {i}: OK   sent={r['sent']} debited={r['debited']}"
                   f" (seed {args.seed})")

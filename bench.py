@@ -675,6 +675,57 @@ def _analytics_ab(inst, call, pairs=5, reps=30) -> dict:
         disp.analytics = ana
 
 
+def _tenant_ab(inst, call, pairs=5, reps=30) -> dict:
+    """ISSUE 11 acceptance: tenant attribution must cost < 3 %
+    throughput on top of the analytics tap.  Same interleaved-pair
+    median discipline as ``_analytics_ab``, but the toggle is the
+    ledger itself: detaching ``ana._tenants`` darkens every tenant
+    fold/flag site while the rest of the analytics plane keeps
+    running, so the measured delta is attribution alone."""
+    disp = inst.dispatcher
+    ana = disp.analytics
+    if ana is None:
+        return {"skipped": "no analytics attached (GUBER_ANALYTICS=0)"}
+    ledger = ana._tenants
+    if ledger is None:
+        return {"skipped": "tenant ledger detached"}
+
+    def rate():
+        t0 = time.perf_counter()
+        for r in range(reps):
+            call(r)
+        return reps / (time.perf_counter() - t0)
+
+    try:
+        ratios, on_r, off_r = [], [], []
+        for pair in range(pairs + 1):
+            ana._tenants = ledger
+            on = rate()
+            ana.flush(timeout=5.0)  # paced tenant folds out of OFF arm
+            ana._tenants = None
+            off = rate()
+            if pair == 0:
+                continue  # warmup pair, untimed
+            ratios.append(off / on)
+            on_r.append(on)
+            off_r.append(off)
+        overhead = (float(np.median(ratios)) - 1.0) * 100
+        row = {"overhead_pct": round(overhead, 2),
+               "overhead_ok": bool(overhead < 3.0),
+               "on_calls_per_s": round(float(np.median(on_r)), 1),
+               "off_calls_per_s": round(float(np.median(off_r)), 1),
+               "pairs": pairs, "reps": reps}
+        if not row["overhead_ok"]:
+            row["warning"] = ("tenant attribution measured above the "
+                              "3% budget on this run; single-host "
+                              "noise — re-run before acting on it")
+        return row
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+    finally:
+        ana._tenants = ledger
+
+
 def _faults_ab(inst, call, pairs=5, reps=30) -> dict:
     """ISSUE 5 acceptance: fault injection must be zero-cost while
     disarmed (<1% on the service path with GUBER_FAULT unset).
@@ -1066,6 +1117,15 @@ def _sec_svc():
                     datas[r % 4], now_ms=NOW0 + 500 + r))
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["analytics_ab"] = {
+                "error": (str(e) or repr(e))[:200]}
+        # ISSUE 11 acceptance: tenant attribution overhead A/B on the
+        # same wire-lane call (<3% on top of the analytics tap)
+        try:
+            out["6_service_path"]["tenant_ab"] = _tenant_ab(
+                inst, lambda r: inst.get_rate_limits_wire(
+                    datas[r % 4], now_ms=NOW0 + 600 + r))
+        except Exception as e:  # noqa: BLE001
+            out["6_service_path"]["tenant_ab"] = {
                 "error": (str(e) or repr(e))[:200]}
         # ISSUE 5 acceptance: disarmed faultpoint checks must cost <1%
         # on the service path (same request bytes as the loops above)
@@ -1743,6 +1803,14 @@ def _sec_mesh():
             # mesh mode's whole point: nothing ever queued for gRPC
             "zero_peer_rpcs": (not gm._hits and not gm._hits_raw),
         })
+        # ISSUE 11: the fitted collective cost model from this row's
+        # live folds — α (launch + rendezvous) and β (per byte) per
+        # (phase, ndev) bucket, the constants the hierarchical-
+        # reconcile ROADMAP item prices levels with (see
+        # tools/costmodel_dryrun.py for the held-out validation)
+        ana = mi.analytics
+        if ana is not None:
+            row["cost_model"] = ana.costmodel_snapshot()
     finally:
         mi.close()
     gi = V1Instance(Config(cache_size=1 << 14, sweep_interval_ms=0,

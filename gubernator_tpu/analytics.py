@@ -385,6 +385,17 @@ class PhaseLedger:
             a = self._agg.get(phase)
             return (a[1] / a[0]) if a and a[0] else None
 
+    def recent_p99(self, phase: str) -> Optional[float]:
+        """p99 seconds over the bounded recent window of one phase
+        (None before any sample) — the SLO engine's decision-latency
+        feed; cheaper than a full snapshot() every tick."""
+        with self._mu:
+            d = self._recent.get(phase)
+            if not d:
+                return None
+            xs = np.asarray(d, float)
+        return float(np.percentile(xs, 99))
+
     def snapshot(self) -> Dict[str, dict]:
         with self._mu:
             out = {}
@@ -398,6 +409,267 @@ class PhaseLedger:
                     "max_ms": round(float(xs.max()) * 1e3, 4),
                 }
             return out
+
+
+def _read_varint(data, pos: int):
+    shift = result = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint overflow")
+
+
+def _skip_field(data, wt: int, pos: int) -> int:
+    if wt == 0:
+        _, pos = _read_varint(data, pos)
+    elif wt == 1:
+        pos += 8
+    elif wt == 5:
+        pos += 4
+    else:
+        raise ValueError(f"wire type {wt}")
+    return pos
+
+
+def iter_wire_names(data) -> List[tuple]:
+    """(name, unique_key) per request TLV of a serialized
+    GetRateLimitsReq — a tolerant pure-Python walk (field 1 = repeated
+    RateLimitReq; inside it field 1 = name, field 2 = unique_key).
+    Runs on the analytics worker ONLY for waves carrying khashes the
+    tenant cache hasn't seen, so steady-state traffic never parses."""
+    out: List[tuple] = []
+    pos, end = 0, len(data)
+    while pos < end:
+        tag, pos = _read_varint(data, pos)
+        if tag & 7 != 2:
+            pos = _skip_field(data, tag & 7, pos)
+            continue
+        ln, pos = _read_varint(data, pos)
+        body_end = pos + ln
+        if tag >> 3 == 1:
+            name = uniq = ""
+            p = pos
+            while p < body_end:
+                t, p = _read_varint(data, p)
+                if t & 7 != 2:
+                    p = _skip_field(data, t & 7, p)
+                    continue
+                sl, p = _read_varint(data, p)
+                if t >> 3 == 1:
+                    name = bytes(data[p:p + sl]).decode("utf-8",
+                                                        "replace")
+                elif t >> 3 == 2:
+                    uniq = bytes(data[p:p + sl]).decode("utf-8",
+                                                        "replace")
+                p += sl
+            if name:
+                out.append((name, uniq))
+        pos = body_end
+    return out
+
+
+class TenantLedger:
+    """Bounded-cardinality per-tenant RED ledger (ISSUE 11).
+
+    A tenant IS a key prefix (ROADMAP › multi-tenant QoS): the id is
+    the key name up to the first ``delim`` (the whole name when the
+    delimiter is absent).  At most ``max_tenants`` distinct ids get
+    their own bucket; every later newcomer folds into ``__other__``
+    (bucket 0), so label cardinality, memory, and the /debug/tenants
+    payload are all bounded no matter how adversarial the key mix is.
+
+    Conservation is structural, not statistical: every attributed row
+    lands in EXACTLY one bucket (a real tenant, or ``__other__`` for
+    overflow and unresolvable khashes), so per-tenant counts sum to
+    the totals row exactly — asserted under the 16-thread chaos soak
+    by tests/test_slo_tenants.py.
+
+    Thread-safe (own leaf lock); the analytics worker does the bulk
+    vectorized folds, flag taps trickle in from serving threads.
+    """
+
+    OTHER = "__other__"
+    FIELDS = ("requests", "hits", "over_limit", "errors", "degraded",
+              "shed")
+
+    def __init__(self, delim: Optional[str] = None,
+                 max_tenants: Optional[int] = None):
+        if delim is None:
+            delim = os.environ.get("GUBER_TENANT_DELIM", "/") or "/"
+        self.delim = delim
+        self.max_tenants = (max_tenants if max_tenants is not None
+                            else _env_int("GUBER_TENANT_MAX", 64))
+        self._mu = threading.Lock()
+        self._idx: Dict[str, int] = {self.OTHER: 0}  # guarded-by: self._mu
+        self._tenant_names: List[str] = [self.OTHER]  # guarded-by: self._mu
+        #: per-bucket [requests, hits, over, errors, degraded, shed]
+        self._counts: List[list] = [[0] * 6]  # guarded-by: self._mu
+        self._overflowed = False  # guarded-by: self._mu
+
+    def tenant_of(self, name: str) -> str:
+        """Raw prefix extraction — no bucket assignment, no bounding.
+        Safe from any thread; used for event-field hints."""
+        i = name.find(self.delim)
+        return name if i < 0 else name[:i]
+
+    def index_of(self, name: str, pre_split: bool = False) -> int:
+        """Bucket index for a key name (or an already-extracted tenant
+        id when ``pre_split``), assigning a new bucket while room
+        remains and folding overflow into ``__other__``."""
+        tenant = name if pre_split else self.tenant_of(name)
+        with self._mu:
+            i = self._idx.get(tenant)
+            if i is not None:
+                return i
+            if len(self._tenant_names) > self.max_tenants:
+                self._overflowed = True
+                return 0
+            i = len(self._tenant_names)
+            self._idx[tenant] = i
+            self._tenant_names.append(tenant)
+            self._counts.append([0] * 6)
+            return i
+
+    def fold(self, tidx: np.ndarray, hits: np.ndarray,
+             over: np.ndarray) -> None:
+        """Vectorized bulk attribution of one drained batch: one
+        bincount per column, applied to every touched bucket."""
+        nb = len(self._tenant_names)  # lock-free: buckets only grow; every tidx was assigned against a ledger of <= nb buckets
+        req = np.bincount(tidx, minlength=nb)
+        h = np.bincount(tidx, weights=np.asarray(hits, np.float64),
+                        minlength=nb)
+        o = np.bincount(tidx, weights=np.asarray(over, np.float64),
+                        minlength=nb)
+        touched = np.nonzero(req)[0]
+        with self._mu:
+            for b in touched:
+                c = self._counts[b]
+                c[0] += int(req[b])
+                c[1] += int(h[b])
+                c[2] += int(o[b])
+
+    def add(self, idx: int, field: str, n: int = 1) -> None:
+        f = self.FIELDS.index(field)
+        with self._mu:
+            self._counts[idx][f] += int(n)
+
+    def totals(self) -> Dict[str, int]:
+        with self._mu:
+            sums = [sum(c[f] for c in self._counts)
+                    for f in range(6)]
+        return dict(zip(self.FIELDS, sums))
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            tenants = {name: dict(zip(self.FIELDS, counts))
+                       for name, counts in zip(self._tenant_names,
+                                               self._counts)}
+            overflowed = self._overflowed
+        totals = {f: sum(t[f] for t in tenants.values())
+                  for f in self.FIELDS}
+        return {"delim": self.delim, "max_tenants": self.max_tenants,
+                "overflowed": overflowed,
+                "tenant_count": len(tenants),
+                "tenants": tenants, "totals": totals}
+
+    def red(self, kind: str) -> Dict[str, tuple]:
+        """Cumulative (bad, total) per tenant for the SLO engine's
+        per-tenant groups: ``errors`` → (errors + degraded, requests);
+        ``shed`` → (shed, requests + shed)."""
+        with self._mu:
+            out = {}
+            for name, c in zip(self._tenant_names, self._counts):
+                if kind == "shed":
+                    bad, total = c[5], c[0] + c[5]
+                else:
+                    bad, total = c[3] + c[4], c[0]
+                if total:
+                    out[name] = (bad, total)
+            return out
+
+
+class CostModel:
+    """Online α-β collective cost model: T(bytes) = α + β·bytes per
+    (phase, device-count) bucket, the AllReduce time model from
+    "Revisiting the Time Cost Model of AllReduce" (PAPERS.md) that the
+    hierarchical-reconcile ROADMAP item needs per level.
+
+    Each ``global_fold`` / ``broadcast`` / ``peer_flush`` phase record
+    contributes one (bytes, seconds) sample; the fit is closed-form
+    least squares over five running sums — no history kept, no deps,
+    O(1) per sample.  Thread-safe (own leaf lock).
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        #: (phase, ndev) → [n, Σx, Σy, Σxx, Σxy]   guarded-by: self._mu
+        self._b: Dict[tuple, list] = {}
+
+    def add(self, phase: str, nbytes: int, ndev: int,
+            seconds: float) -> None:
+        x, y = float(nbytes), float(seconds)
+        with self._mu:
+            b = self._b.get((phase, int(ndev)))
+            if b is None:
+                b = self._b[(phase, int(ndev))] = [0, 0.0, 0.0, 0.0,
+                                                   0.0]
+            b[0] += 1
+            b[1] += x
+            b[2] += y
+            b[3] += x * x
+            b[4] += x * y
+
+    @staticmethod
+    def _solve(b: list) -> Optional[dict]:
+        n, sx, sy, sxx, sxy = b
+        if n < 2:
+            return None
+        det = n * sxx - sx * sx
+        if det <= 1e-12 * max(n * sxx, 1.0):
+            # degenerate (all samples one size): β unidentifiable,
+            # report the mean as pure α
+            return {"n": int(n), "alpha_s": sy / n,
+                    "beta_s_per_byte": 0.0, "mean_bytes": sx / n}
+        beta = (n * sxy - sx * sy) / det
+        alpha = (sy - beta * sx) / n
+        return {"n": int(n), "alpha_s": alpha,
+                "beta_s_per_byte": beta, "mean_bytes": sx / n}
+
+    def fit(self, phase: str, ndev: int) -> Optional[dict]:
+        with self._mu:
+            b = self._b.get((phase, int(ndev)))
+            b = list(b) if b else None
+        return self._solve(b) if b else None
+
+    def predict(self, phase: str, ndev: int,
+                nbytes: int) -> Optional[float]:
+        f = self.fit(phase, ndev)
+        if f is None:
+            return None
+        return f["alpha_s"] + f["beta_s_per_byte"] * float(nbytes)
+
+    def snapshot(self) -> dict:
+        """The ``GET /debug/costmodel`` document: every bucket's fitted
+        constants (α in seconds, β in seconds/byte) + sample counts."""
+        with self._mu:
+            items = [(k, list(v)) for k, v in self._b.items()]
+        buckets = []
+        for (phase, ndev), b in sorted(items):
+            f = self._solve(b)
+            row = {"phase": phase, "ndev": ndev, "samples": int(b[0])}
+            if f is not None:
+                row.update({"alpha_us": round(f["alpha_s"] * 1e6, 3),
+                            "beta_ns_per_byte":
+                                round(f["beta_s_per_byte"] * 1e9, 6),
+                            "mean_bytes": round(f["mean_bytes"], 1)})
+            buckets.append(row)
+        return {"model": "T = alpha + beta * bytes",
+                "buckets": buckets}
 
 
 class _Flush:
@@ -443,6 +715,23 @@ class KeyAnalytics:
         self._mu = threading.Lock()  # guards sketch + counters
         self.sketch = HeavyHitterSketch(k=k, width=width)  # guarded-by: self._mu
         self.phases = PhaseLedger()  # internally locked (own _mu)
+        #: per-tenant RED ledger (ISSUE 11); None disables attribution
+        #: entirely (the bench A/B detaches it the way it detaches
+        #: the whole analytics plane)
+        self._tenants: Optional[TenantLedger] = TenantLedger()
+        #: α-β collective cost model; taps go straight in (leaf lock,
+        #: samples arrive from reconcile/flush threads, never hot)
+        self.costmodel = CostModel()
+        #: khash → tenant bucket index, learned from named taps and
+        #: wire-name learn items.  Worker-thread-owned writes; GIL-
+        #: atomic .get() reads serve event-field hints.  lock-free
+        self._kh_tenant: Dict[int, int] = {}
+        self._kh_cap = max(8 * width, 4096)
+        # lazily rebuilt sorted lookup for vectorized fold attribution
+        # (worker-thread only)
+        self._kh_sorted = np.empty(0, np.uint64)
+        self._kh_tidx = np.empty(0, np.int64)
+        self._kh_dirty = False
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_cap)
         self._waves = 0  # guarded-by: self._mu
         self._dropped = 0  # guarded-by: self._mu
@@ -474,6 +763,37 @@ class KeyAnalytics:
             return True
         return self._put(("reqs", list(reqs), list(resps),
                           int(self._clock() * 1000)))
+
+    def tap_wire_names(self, data, khash=None, raw: bool = False
+                       ) -> bool:
+        """Tenant learn tap for the columnar wire lanes, which carry
+        only khashes: enqueue the (immutable) wire bytes plus the
+        lane's khash view so the WORKER can map new khashes to tenant
+        ids — zero copies, zero parsing on the serving path.  ``raw``
+        marks a pre-mix khash (parse output); the worker applies the
+        finalizer itself.  FIFO ordering guarantees the learn lands
+        before the wave's own "cols"/"dev" item is folded."""
+        if self._tenants is None:
+            return True
+        return self._put(("learn", data, khash, raw))
+
+    def tap_flag(self, field: str, n: int = 1,
+                 tenant: Optional[str] = None,
+                 khash: Optional[int] = None,
+                 name: Optional[str] = None) -> bool:
+        """Exceptional-outcome attribution (``errors`` / ``degraded``
+        / ``shed``): cheap enqueue from the serving path, resolved to
+        a tenant bucket on the worker (explicit tenant id → khash
+        cache → key name → ``__other__``)."""
+        if self._tenants is None:
+            return True
+        return self._put(("flag", field, int(n), tenant, khash, name))
+
+    def tap_cost(self, phase: str, nbytes: int, ndev: int,
+                 seconds: float) -> None:
+        """One collective cost sample (leaf-locked, direct — callers
+        are reconcile ticks and flush completions, never wave-rate)."""
+        self.costmodel.add(phase, nbytes, ndev, seconds)
 
     def tap_device(self, tap) -> bool:
         """Fused-engine wave tap (ISSUE 8): ``tap`` is the [4, B] int64
@@ -554,6 +874,14 @@ class KeyAnalytics:
                     c = self._dev_to_cols(item)
                     if c is not None:
                         cols.append(c)
+                elif item[0] == "learn":
+                    # tenant-cache learn MUST precede the fold of any
+                    # cols queued behind it (FIFO), and folding the
+                    # ones queued AHEAD of it later is harmless — so
+                    # apply immediately, no barrier
+                    self._safe_learn(item)
+                elif item[0] == "flag":
+                    self._safe_flag(item)
                 else:
                     # object-lane (named) tap: fold queued columns
                     # first so wave order is preserved
@@ -584,6 +912,10 @@ class KeyAnalytics:
             with self._mu:
                 self.sketch.update(khash, hits, over, t_ms)
                 self._waves += len(cols)
+            if self._tenants is not None:
+                self._fold_tenants(np.asarray(khash, np.uint64),
+                                   np.asarray(hits, np.int64),
+                                   np.asarray(over, bool))
             if self.metrics is not None:
                 self.metrics.analytics_waves.inc(len(cols))
             self._maybe_publish()
@@ -616,9 +948,146 @@ class KeyAnalytics:
         with self._mu:
             self.sketch.update(khash, hits, over, t_ms, names=names)
             self._waves += 1
+        tl = self._tenants
+        if tl is not None:
+            # learn khash → tenant (object lanes carry names), then
+            # attribute through the same fold path as the wire lanes
+            tidx = np.fromiter(
+                (tl.index_of(r.name) for r in reqs), np.int64,
+                len(reqs))
+            for i in range(len(reqs)):
+                self._kh_note(int(khash[i]), int(tidx[i]))
+            tl.fold(tidx, hits, over)
+            for i, r in enumerate(resps):
+                if getattr(r, "error", ""):
+                    tl.add(int(tidx[i]), "errors", 1)
         if self.metrics is not None:
             self.metrics.analytics_waves.inc()
         self._maybe_publish()
+
+    # ---- tenant attribution (worker thread) -----------------------------
+
+    def _kh_note(self, kh: int, tidx: int) -> None:
+        cache = self._kh_tenant
+        if kh in cache:
+            if cache[kh] != tidx:
+                cache[kh] = tidx
+                self._kh_dirty = True
+            return
+        if len(cache) >= self._kh_cap:
+            # bounded like the sketch's name table: shed the oldest
+            # half (plain dicts pop in insertion order); affected keys
+            # re-learn on their next named/wire appearance and fold
+            # into __other__ meanwhile — conservation holds either way
+            for old in list(cache)[: self._kh_cap // 2]:
+                del cache[old]
+        cache[kh] = tidx
+        self._kh_dirty = True
+
+    def _kh_lookup_arrays(self):
+        if self._kh_dirty or self._kh_sorted.size != len(self._kh_tenant):
+            kh = np.fromiter(self._kh_tenant.keys(), np.uint64,
+                             len(self._kh_tenant))
+            ti = np.fromiter(self._kh_tenant.values(), np.int64,
+                             len(self._kh_tenant))
+            order = np.argsort(kh)
+            self._kh_sorted = kh[order]
+            self._kh_tidx = ti[order]
+            self._kh_dirty = False
+        return self._kh_sorted, self._kh_tidx
+
+    def _fold_tenants(self, khash, hits, over) -> None:
+        """Attribute one folded batch to tenant buckets: vectorized
+        searchsorted against the learned khash cache; khashes the
+        cache can't resolve land in ``__other__`` (bucket 0) so every
+        row is counted exactly once."""
+        tl = self._tenants
+        ks, ti = self._kh_lookup_arrays()
+        if ks.size:
+            pos = np.minimum(np.searchsorted(ks, khash), ks.size - 1)
+            known = ks[pos] == khash
+            tidx = np.where(known, ti[pos], 0)
+        else:
+            tidx = np.zeros(len(khash), np.int64)
+        tl.fold(tidx, hits, over)
+
+    def _safe_learn(self, item) -> None:
+        try:
+            self._apply_learn(item)
+        except Exception:  # pragma: no cover - must never die
+            import logging
+
+            logging.getLogger("gubernator_tpu.analytics").exception(
+                "tenant learn")
+
+    def _apply_learn(self, item) -> None:
+        tl = self._tenants
+        if tl is None:
+            return
+        _, data, kh, raw = item
+        if kh is not None and len(self._kh_tenant):
+            khm = np.asarray(kh)
+            if khm.dtype != np.uint64:
+                khm = khm.view(np.uint64) if khm.dtype == np.int64 \
+                    else khm.astype(np.uint64)
+            if raw:
+                from .hashing import mix64_np
+
+                khm = mix64_np(khm)
+            ks, _ = self._kh_lookup_arrays()
+            pos = np.minimum(np.searchsorted(ks, khm), ks.size - 1)
+            if bool((ks[pos] == khm).all()):
+                return  # steady state: every khash known, no parse
+        pairs = iter_wire_names(data)
+        if not pairs:
+            return
+        from .hashing import hash_request_keys
+
+        khash = hash_request_keys([p[0] for p in pairs],
+                                  [p[1] for p in pairs])
+        for i, (name, _uniq) in enumerate(pairs):
+            self._kh_note(int(khash[i]), tl.index_of(name))
+
+    def _safe_flag(self, item) -> None:
+        try:
+            tl = self._tenants
+            if tl is None:
+                return
+            _, field, n, tenant, khash, name = item
+            if tenant is not None:
+                idx = tl.index_of(tenant, pre_split=True)
+            elif khash is not None and khash in self._kh_tenant:
+                idx = self._kh_tenant[khash]
+            elif name is not None:
+                idx = tl.index_of(name)
+            else:
+                idx = 0
+            tl.add(idx, field, n)
+        except Exception:  # pragma: no cover - must never die
+            import logging
+
+            logging.getLogger("gubernator_tpu.analytics").exception(
+                "tenant flag")
+
+    def tenant_hint(self, khash: Optional[int] = None,
+                    name: Optional[str] = None) -> Optional[str]:
+        """Best-effort tenant id for event fields: khash → learned
+        bucket name (GIL-atomic dict read of worker-owned state),
+        else the raw prefix of ``name``.  Never assigns buckets, so
+        it is safe (and cheap) from any serving thread."""
+        tl = self._tenants
+        if tl is None:
+            return None
+        if khash is not None:
+            idx = self._kh_tenant.get(int(khash))
+            if idx is not None:
+                try:
+                    return tl._tenant_names[idx]
+                except IndexError:  # pragma: no cover - benign race
+                    return None
+        if name is not None:
+            return tl.tenant_of(name)
+        return None
 
     def _maybe_publish(self) -> None:
         now = time.monotonic()
@@ -663,6 +1132,23 @@ class KeyAnalytics:
             for label, val in fresh.items():
                 gauge.labels(key=label).set(val)
             self._published = fresh
+        self._publish_tenants()
+
+    def _publish_tenants(self) -> None:
+        """gubernator_tenant_* gauge refresh: cardinality is bounded
+        by the ledger itself (GUBER_TENANT_MAX + __other__), and
+        buckets never depart, so no label removal pass is needed."""
+        tl = self._tenants
+        m = self.metrics
+        if tl is None or m is None:
+            return
+        gauges = (m.tenant_requests, m.tenant_hits,
+                  m.tenant_over_limit, m.tenant_errors,
+                  m.tenant_degraded, m.tenant_shed)
+        snap = tl.snapshot()
+        for tenant, counts in snap["tenants"].items():
+            for gauge, field in zip(gauges, TenantLedger.FIELDS):
+                gauge.labels(tenant=tenant).set(float(counts[field]))
 
     # ---- reporting ------------------------------------------------------
 
@@ -718,6 +1204,31 @@ class KeyAnalytics:
 
     def phases_snapshot(self) -> dict:
         return {"phases": self.phases.snapshot()}
+
+    def tenants_snapshot(self) -> dict:
+        """The ``GET /debug/tenants`` document."""
+        tl = self._tenants
+        if tl is None:
+            return {"enabled": False}
+        out = tl.snapshot()
+        out["enabled"] = True
+        return out
+
+    def tenant_red(self, kind: str) -> Dict[str, tuple]:
+        """Per-tenant cumulative (bad, total) feed for the SLO
+        engine's tenant groups (empty when attribution is off)."""
+        tl = self._tenants
+        return tl.red(kind) if tl is not None else {}
+
+    def tenant_totals(self) -> Dict[str, int]:
+        tl = self._tenants
+        if tl is None:
+            return {}
+        return tl.totals()
+
+    def costmodel_snapshot(self) -> dict:
+        """The ``GET /debug/costmodel`` document."""
+        return self.costmodel.snapshot()
 
     def close(self) -> None:
         self._closing = True

@@ -61,6 +61,24 @@ def _debug_main(argv) -> int:
     tk.add_argument("--timeout", type=float, default=10.0)
     tk.add_argument("--json", action="store_true",
                     help="print the raw JSON document")
+    tn = sub.add_parser("tenants",
+                        help="dump the daemon's per-tenant RED ledger "
+                             "(/debug/tenants)")
+    tn.add_argument("--url", default="http://localhost:1050",
+                    help="daemon HTTP base url (or a full "
+                         "/debug/tenants url)")
+    tn.add_argument("--timeout", type=float, default=10.0)
+    tn.add_argument("--json", action="store_true",
+                    help="print the raw JSON document")
+    sl = sub.add_parser("slo",
+                        help="dump the daemon's SLO burn-rate verdicts "
+                             "(/debug/slo)")
+    sl.add_argument("--url", default="http://localhost:1050",
+                    help="daemon HTTP base url (or a full "
+                         "/debug/slo url)")
+    sl.add_argument("--timeout", type=float, default=10.0)
+    sl.add_argument("--json", action="store_true",
+                    help="print the raw JSON document")
     fl = sub.add_parser("faults",
                         help="inspect or arm the daemon's fault-"
                              "injection points (/debug/faults)")
@@ -79,6 +97,10 @@ def _debug_main(argv) -> int:
     args = ap.parse_args(argv)
     if args.what == "topkeys":
         return _debug_topkeys(args)
+    if args.what == "tenants":
+        return _debug_tenants(args)
+    if args.what == "slo":
+        return _debug_slo(args)
     if args.what == "faults":
         return _debug_faults(args)
 
@@ -154,6 +176,70 @@ def _debug_topkeys(args) -> int:
         print(line)
     if not keys:
         print("(no keys tracked)", file=sys.stderr)
+    return 0
+
+
+def _debug_tenants(args) -> int:
+    """``debug tenants``: the per-tenant RED ledger round trip."""
+    url = args.url
+    if "/debug/tenants" not in url:
+        url = url.rstrip("/") + "/debug/tenants"
+    try:
+        body = _fetch_json(url, args.timeout)
+    except Exception as e:  # noqa: BLE001
+        print(f"fetch failed: {e!r}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body))
+        return 0
+    if not body.get("enabled", False):
+        print("tenant attribution disabled", file=sys.stderr)
+        return 1
+    print(f"tenants: {body.get('tenant_count')} "
+          f"(delim={body.get('delim')!r} max={body.get('max_tenants')} "
+          f"overflowed={body.get('overflowed')})")
+    hdr = ("requests", "hits", "over_limit", "errors", "degraded",
+           "shed")
+    print(f"{'tenant':<24}" + "".join(f"{h:>11}" for h in hdr))
+    rows = sorted(body.get("tenants", {}).items(),
+                  key=lambda kv: -kv[1].get("requests", 0))
+    for name, c in rows:
+        print(f"{name:<24}" + "".join(f"{c.get(h, 0):>11}"
+                                      for h in hdr))
+    tot = body.get("totals", {})
+    print(f"{'TOTAL':<24}" + "".join(f"{tot.get(h, 0):>11}"
+                                     for h in hdr))
+    return 0
+
+
+def _debug_slo(args) -> int:
+    """``debug slo``: the burn-rate verdict round trip."""
+    url = args.url
+    if "/debug/slo" not in url:
+        url = url.rstrip("/") + "/debug/slo"
+    try:
+        body = _fetch_json(url, args.timeout)
+    except Exception as e:  # noqa: BLE001
+        print(f"fetch failed: {e!r}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body))
+        return 0
+    print(f"windows: fast={body.get('fast_window_s')}s "
+          f"slow={body.get('slow_window_s')}s "
+          f"threshold={body.get('burn_threshold')} "
+          f"ticks={body.get('ticks')}")
+    for r in body.get("slos", []):
+        name = r["slo"]
+        if r.get("tenant"):
+            name += f"[{r['tenant']}]"
+        state = "BREACH" if r.get("breached") else "ok"
+        line = (f"  {name:<40} {state:<7} "
+                f"fast={r.get('fast_burn'):<8} "
+                f"slow={r.get('slow_burn'):<8}")
+        if r.get("value") is not None:
+            line += (f" value={r['value']} target={r['target']}")
+        print(line)
     return 0
 
 

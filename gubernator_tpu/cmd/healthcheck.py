@@ -9,6 +9,11 @@ state — see OBSERVABILITY.md).  A diagnosed stall does NOT flip the
 exit code by itself (a cold compile recovers on its own; restarting the
 container mid-compile would make it worse) unless ``--fail-on-stall``
 is also given.
+
+``--fail-on-burn`` (implies deep mode) exits 1 while any SLO is in the
+breached state (slo.py) — the k8s READINESS hook: a pod burning its
+error budget stops taking traffic before it pages an operator, and
+recovery (fast-window burn back under the threshold) re-admits it.
 """
 from __future__ import annotations
 
@@ -37,8 +42,13 @@ def main(argv=None) -> int:
     ap.add_argument("--fail-on-stall", action="store_true",
                     help="with --deep: exit 1 when the dispatcher "
                          "reports a stalled wave")
+    ap.add_argument("--fail-on-burn", action="store_true",
+                    help="exit 1 when any SLO is breached (implies "
+                         "--deep; the k8s readiness hook — a burning "
+                         "pod stops taking traffic before it pages)")
     args = ap.parse_args(argv)
-    url = _with_deep(args.url) if args.deep else args.url
+    deep = args.deep or args.fail_on_burn
+    url = _with_deep(args.url) if deep else args.url
     try:
         with urllib.request.urlopen(url, timeout=args.timeout) as f:
             body = json.loads(f.read())
@@ -49,6 +59,15 @@ def main(argv=None) -> int:
     if body.get("status") != "healthy":
         print(f"unhealthy: {body}", file=sys.stderr)
         return 1
+    slo = body.get("slo")
+    if args.fail_on_burn and slo is not None:
+        if slo.get("breached"):
+            print(f"SLO breached: {', '.join(slo['breached'])} "
+                  f"(max_fast_burn={slo.get('max_fast_burn')}, "
+                  f"threshold={slo.get('burn_threshold')})",
+                  file=sys.stderr)
+            return 1
+        print("slo:", json.dumps(slo, sort_keys=True))
     disp = body.get("dispatcher")
     if args.deep and disp is not None:
         print("dispatcher:", json.dumps(disp, sort_keys=True))

@@ -57,6 +57,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_COALESCE_US": "dispatcher coalescing window in µs (0 disables the wait)",
     "GUBER_CREATED_AT_FWD": "0 disables caller-clock forwarding (created_at stamp) — pre-fix cold-key-loss demo ONLY",
     "GUBER_DATA_CENTER": "data-center name for DC-aware picking",
+    "GUBER_DEBUG_DUMP_DIR": "crash forensics: close() dumps the event ring + final SLO verdicts here as JSONL",
     "GUBER_DNS_FQDN": "DNS discovery: FQDN to resolve for peers",
     "GUBER_DNS_RESOLVE_INTERVAL": "DNS discovery: re-resolve interval (duration)",
     "GUBER_DRAIN_GRACE": "graceful-shutdown drain budget (duration); bounds every drain join",
@@ -107,10 +108,18 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_SESSION_BENCH_TIMEOUT": "tools/tpu_session: bench stage timeout seconds",
     "GUBER_SESSION_EXTRAS_OUT": "tools/tpu_session: extras checkpoint JSON path",
     "GUBER_SKETCH_WIDTH": "heavy-hitter sketch counter width (default 4×TOPK)",
+    "GUBER_SLO": "0 disables the in-process SLO burn-rate engine",
+    "GUBER_SLO_BURN": "burn-rate breach threshold (multiple of the error-budget spend rate, default 2.0)",
+    "GUBER_SLO_FAST": "SLO fast burn window (duration, default 1m)",
+    "GUBER_SLO_P99_MS": "decision_p99 SLO target: device-phase p99 ms (default 250)",
+    "GUBER_SLO_SLOW": "SLO slow burn window (duration, default 5m)",
+    "GUBER_SLO_TICK": "SLO engine evaluation interval (duration, default 1s)",
     "GUBER_SNAPSHOT_PATH": "Loader snapshot path (save on close, load on start)",
     "GUBER_STALL_THRESHOLD_S": "wave stall-watchdog threshold seconds; <=0 disables",
     "GUBER_STEP_DONATE": "0 disables donated (aliased) step buffers",
     "GUBER_STEP_IMPL": "device step implementation (xla/pallas)",
+    "GUBER_TENANT_DELIM": "tenant id = key-name prefix up to this delimiter (default /)",
+    "GUBER_TENANT_MAX": "max distinct tenant buckets; overflow folds into __other__ (default 64)",
     "GUBER_TIER_COLD": "1 enables the host cold tier behind the device table",
     "GUBER_TIER_NATIVE": "0 forces the pure-python cold-store fallback",
     "GUBER_TIER_PROMOTE": "sketch-rank admission threshold for cold->hot promotion",

@@ -337,6 +337,10 @@ class Daemon:
                                 peers_blk[p.info.grpc_address] = \
                                     p.lane_stats()
                         body["peers"] = peers_blk
+                        # SLO verdicts (ISSUE 11): breached / burning
+                        # objectives — the --fail-on-burn readiness feed
+                        if daemon.instance.slo is not None:
+                            body["slo"] = daemon.instance.slo.health()
                     self._send(code, json.dumps(body).encode())
                 elif path == "/debug/events":
                     # flight recorder ring (telemetry.py), newest-last;
@@ -352,10 +356,11 @@ class Daemon:
                         since = int(q.get("since_seq", ["0"])[-1]) or None
                     except ValueError:
                         since = None
+                    tenant = q.get("tenant", [""])[-1] or None
                     self._send(200, json.dumps({
                         "events": daemon.instance.recorder.events(
                             limit=limit, kind=kind,
-                            since_seq=since)}).encode())
+                            since_seq=since, tenant=tenant)}).encode())
                 elif path == "/debug/topkeys":
                     # heavy-hitter ledger (analytics.py): the current
                     # top-K keys with hits / over-limit / error bound /
@@ -395,6 +400,39 @@ class Daemon:
                          "wave_duration_p99_ms", "queue_wait_p50_ms",
                          "queue_wait_p99_ms")}
                     self._send(200, json.dumps(body).encode())
+                elif path == "/debug/tenants":
+                    # per-tenant RED ledger (analytics.py ›
+                    # TenantLedger): bounded-cardinality request /
+                    # over-limit / error / degraded / shed attribution
+                    ana = daemon.instance.analytics
+                    if ana is None:
+                        self._send(404, json.dumps(
+                            {"error": "analytics disabled "
+                                      "(GUBER_ANALYTICS=0)"}).encode())
+                        return
+                    ana.flush(timeout=2.0)  # fold queued taps first
+                    self._send(200, json.dumps(
+                        ana.tenants_snapshot()).encode())
+                elif path == "/debug/slo":
+                    # SLO registry + live burn rates (slo.py)
+                    if daemon.instance.slo is None:
+                        self._send(404, json.dumps(
+                            {"error": "slo engine disabled "
+                                      "(GUBER_SLO=0)"}).encode())
+                        return
+                    self._send(200, json.dumps(
+                        daemon.instance.slo.snapshot()).encode())
+                elif path == "/debug/costmodel":
+                    # fitted collective cost model (analytics.py ›
+                    # CostModel): per-(phase, ndev) alpha/beta
+                    ana = daemon.instance.analytics
+                    if ana is None:
+                        self._send(404, json.dumps(
+                            {"error": "analytics disabled "
+                                      "(GUBER_ANALYTICS=0)"}).encode())
+                        return
+                    self._send(200, json.dumps(
+                        ana.costmodel_snapshot()).encode())
                 elif path == "/debug/profile":
                     code, body = daemon._handle_profile(q)
                     self._send(code, json.dumps(body).encode())

@@ -384,7 +384,8 @@ class Dispatcher:
             return False
         return True
 
-    def run_inline_wave(self, kind: str, nreq: int, fn):
+    def run_inline_wave(self, kind: str, nreq: int, fn,
+                        tenant: Optional[str] = None):
         """Run ``fn()`` (an engine call the caller composed — the fused
         wire lane, instance.py › _wire_check_fused) as ONE inline wave
         in the calling thread, with the same engine-lock discipline and
@@ -395,7 +396,7 @@ class Dispatcher:
         if not self._try_inline():
             return self._BUSY
         try:
-            wid = self._wave_begin(kind, nreq=nreq)
+            wid = self._wave_begin(kind, nreq=nreq, tenant=tenant)
             try:
                 self._mark_pack(wid)
                 with self._engine_lock:
@@ -422,7 +423,8 @@ class Dispatcher:
         thread handoff)."""
         if self._try_inline():
             try:
-                wid = self._wave_begin("inline", nreq=len(reqs))
+                wid = self._wave_begin("inline", nreq=len(reqs),
+                                       tenant=self._hint_reqs(reqs))
                 try:
                     self._mark_pack(wid)
                     with self._engine_lock:
@@ -465,7 +467,9 @@ class Dispatcher:
         column tuples."""
         if self._try_inline():
             try:
-                wid = self._wave_begin("inline_packed", nreq=len(khash))
+                wid = self._wave_begin("inline_packed",
+                                       nreq=len(khash),
+                                       tenant=self._hint_khash(khash))
                 try:
                     self._mark_pack(wid)
                     with self._engine_lock:
@@ -495,9 +499,22 @@ class Dispatcher:
 
     # ---- overload admission control (ISSUE 5) ---------------------------
 
-    def _shed(self, reason: str, nrows: int) -> None:
+    def _shed(self, reason: str, nrows: int,
+              tenant_cb=None) -> None:
         if self.metrics is not None:
             self.metrics.admission_shed.labels(reason=reason).inc(nrows)
+        # tenant attribution (ISSUE 11): resolved LAZILY — only sheds
+        # pay the callback (a prefix split or a dict probe), the admit
+        # fast path never does
+        tenant = None
+        if tenant_cb is not None:
+            try:
+                tenant = tenant_cb()
+            except Exception:  # pragma: no cover - attribution only
+                tenant = None
+        ana = self.analytics
+        if ana is not None:
+            ana.tap_flag("shed", nrows, tenant=tenant)
         with self._submit_mu:
             self._shed_rows += nrows
             now = self._clock()
@@ -507,9 +524,11 @@ class Dispatcher:
         if self.recorder is not None and not throttled:
             # rate-limited: under sustained overload one event per
             # second, not one per rejected call
-            self.recorder.record(
-                "admission_shed", reason=reason, rows=nrows,
-                queued_rows=self._queued_rows)  # lock-free: diagnostic snapshot
+            ev = {"reason": reason, "rows": nrows,
+                  "queued_rows": self._queued_rows}  # lock-free: diagnostic snapshot
+            if tenant is not None:
+                ev["tenant"] = tenant
+            self.recorder.record("admission_shed", **ev)
         raise ResourceExhausted(
             f"admission control shed {nrows} requests ({reason}: "
             f"queued_rows={self._queued_rows}, "  # lock-free: diagnostic snapshot
@@ -548,25 +567,27 @@ class Dispatcher:
 
         return math.ceil(queued / rows_per_wave) * wave_s
 
-    def admit(self, nrows: int, deadline_s: Optional[float] = None
-              ) -> None:
+    def admit(self, nrows: int, deadline_s: Optional[float] = None,
+              tenant_cb=None) -> None:
         """Deadline-aware ingress gate: raise ResourceExhausted instead
         of queueing work that cannot finish.  Cheap — a couple of
         reads; no device work, no allocation on the admit path.
         Deadline shedding only engages when a backlog EXISTS: an idle
-        dispatcher serves any deadline (the wave launches at once)."""
+        dispatcher serves any deadline (the wave launches at once).
+        ``tenant_cb`` (ISSUE 11) resolves the triggering tenant — only
+        invoked when a shed actually happens."""
         if self._draining:
-            self._shed("draining", nrows)
+            self._shed("draining", nrows, tenant_cb)
         lim = self.admission_limit
         if lim and self._queued_rows + nrows > lim:  # lock-free: GIL-atomic int read; admit is approximate by design
-            self._shed("queue_full", nrows)
+            self._shed("queue_full", nrows, tenant_cb)
         dl = deadline_s if deadline_s is not None \
             else _REQUEST_DEADLINE.get()
         if dl is not None and dl > 0 and self._queued_rows > 0:  # lock-free: GIL-atomic int read; admit is approximate by design
             # wait = draining what's AHEAD of this batch; its own
             # service time is not queue wait
             if self.projected_queue_wait_s(0) > dl:
-                self._shed("deadline", nrows)
+                self._shed("deadline", nrows, tenant_cb)
 
     def drain(self) -> None:
         """Enter drain mode: queued/in-flight waves complete, new
@@ -601,7 +622,8 @@ class Dispatcher:
 
     def _wave_begin(self, kind: str, jobs=None, nreq: int = 0,
                     trace: Optional[str] = None,
-                    slot: Optional[int] = None) -> int:
+                    slot: Optional[int] = None,
+                    tenant: Optional[str] = None) -> int:
         t0 = self._clock()
         waits = []
         if jobs:
@@ -617,6 +639,11 @@ class Dispatcher:
             from .tracing import current_trace_id
 
             trace = current_trace_id()
+        if tenant is None and jobs and self.recorder is not None:
+            # event-field hint only (one dict probe / prefix split,
+            # first job names the wave) — ledger attribution happens
+            # in the analytics worker, not here
+            tenant = self._job_tenant(jobs[0])
         gen = self.reconcile_gen
         with self._tel_mu:
             self._wave_seq += 1
@@ -624,6 +651,7 @@ class Dispatcher:
             self._inflight[wid] = {"t0": t0, "kind": kind, "size": nreq,
                                    "trace": trace, "stalled": False,
                                    "slot": slot, "gen": gen,
+                                   "tenant": tenant,
                                    "marks": []}
             self._recent_sizes.append(nreq)
             self._recent_waits.extend(waits)
@@ -644,8 +672,43 @@ class Dispatcher:
                 # pipeline slot this launch occupies (0 = the oldest
                 # in-flight wave) — correlates stalls with ring depth
                 ev["slot"] = slot
+            if tenant is not None:
+                ev["tenant"] = tenant
             self.recorder.record("wave_launched", **ev)
         return wid
+
+    # ---- tenant event hints (ISSUE 11) ----------------------------------
+    #
+    # Wave/shed/degraded events carry a best-effort ``tenant`` field so
+    # one tenant's incident filters server-side (/debug/events?tenant=).
+    # Hints are the RAW key prefix (or the learned bucket for khash-only
+    # lanes) — bounded-cardinality folding only applies to metric
+    # labels, which go through the TenantLedger instead.
+
+    def _hint_reqs(self, reqs) -> Optional[str]:
+        if self.recorder is None or not reqs:
+            return None
+        ana = self.analytics
+        if ana is None:
+            return None
+        return ana.tenant_hint(name=reqs[0].name)
+
+    def _hint_khash(self, khash) -> Optional[str]:
+        if self.recorder is None or len(khash) == 0:
+            return None
+        ana = self.analytics
+        if ana is None:
+            return None
+        return ana.tenant_hint(khash=int(khash[0]))
+
+    def _job_tenant(self, job) -> Optional[str]:
+        reqs = getattr(job, "reqs", None)
+        if reqs:
+            return self._hint_reqs(reqs)
+        kh = getattr(job, "khash", None)
+        if kh is not None:
+            return self._hint_khash(kh)
+        return None
 
     # ---- per-phase attribution (ISSUE 4) --------------------------------
     #
@@ -773,6 +836,8 @@ class Dispatcher:
                 ev["gen"] = info["gen"]
             if info.get("slot") is not None:
                 ev["slot"] = info["slot"]
+            if info.get("tenant") is not None:
+                ev["tenant"] = info["tenant"]
             if phases is not None:
                 # per-phase breakdown in ms; sums to duration_ms
                 ev["phases"] = {k: round(v * 1000, 3)

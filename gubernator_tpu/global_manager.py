@@ -439,6 +439,14 @@ class GlobalManager:
         disp = getattr(self.instance, "dispatcher", None)
         if disp is not None:
             disp._obs_phase("broadcast", dt)
+            ana = getattr(disp, "analytics", None)
+            if ana is not None and peers and not errors:
+                # cost-model sample (ISSUE 11): one broadcast fans the
+                # serialized update set out to every peer.  Errored
+                # rounds are excluded — a timeout's duration measures
+                # the deadline, not the transfer.
+                nbytes = sum(m.ByteSize() for m in msgs) * len(peers)
+                ana.tap_cost("broadcast", nbytes, len(peers) + 1, dt)
         self._record_event("broadcast", keys=len(msgs), peers=len(peers),
                            errors=len(errors),
                            error=("; ".join(errors) or None))

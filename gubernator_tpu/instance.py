@@ -287,6 +287,17 @@ class V1Instance:
             # (decide + scatter in one launch) per wave bucket
             if hasattr(self.engine, "warmup_mesh_fused"):
                 self.engine.warmup_mesh_fused()
+        # Tenant-aware SLO plane (ISSUE 11, slo.py): multi-window
+        # burn-rate verdicts over the signals the layers above emit
+        # (phase ledger p99, mesh staleness, tenant RED ledger).
+        self.slo = None
+        self._slo_loop = None
+        #: monotonic stamp of the last SUCCESSFUL mesh fold; the
+        #: staleness SLO ages against it so a wedged/failing fold
+        #: breaches even though last_staleness_s stops updating
+        self._mesh_last_fold_ok: Optional[float] = None  # lock-free: tick-thread writes, SLO tick reads
+        if os.environ.get("GUBER_SLO", "1") != "0":
+            self._build_slo()
 
     def _build_engine(self, kind: str, m, n: int, cap_local: int,
                       config: Config):
@@ -776,7 +787,8 @@ class V1Instance:
                 f"{MAX_BATCH_SIZE}")
         # overload admission (ISSUE 5): shed cheaply at ingest, before
         # any engine work (raises ResourceExhausted → RESOURCE_EXHAUSTED)
-        self.dispatcher.admit(len(reqs))
+        self.dispatcher.admit(
+            len(reqs), tenant_cb=lambda: self._tenant_of_reqs(reqs))
         now = clock_ms() if now_ms is None else now_ms
         self.metrics.getratelimit_counter.labels(calltype="api").inc(len(reqs))
         self.metrics.concurrent_checks.inc()
@@ -889,7 +901,14 @@ class V1Instance:
                 else:
                     runner = inner
             if runner is not None:
-                self.dispatcher.admit(n)
+                self.dispatcher.admit(
+                    n, tenant_cb=lambda: self._tenant_of_wire(data))
+                ana = self.dispatcher.analytics
+                if ana is not None:
+                    # tenant learn tap: khash_raw rides zero-copy; the
+                    # worker skips the TLV parse once every key is known
+                    ana.tap_wire_names(data, parsed["khash_raw"],
+                                       raw=True)
                 self.metrics.getratelimit_counter.labels(
                     calltype="api").inc(n)
                 self.metrics.wire_lane_counter.labels(lane=lane).inc(n)
@@ -954,10 +973,14 @@ class V1Instance:
                 f"Requests.RateLimits list too large; max size is "
                 f"{MAX_BATCH_SIZE}")
         try:
-            self.dispatcher.admit(pre.n)
+            self.dispatcher.admit(
+                pre.n, tenant_cb=lambda: self._tenant_of_wire(data))
         except BaseException:
             pre.lease.release()
             raise
+        ana = self.dispatcher.analytics
+        if ana is not None:
+            ana.tap_wire_names(data, pre.khash)
         self.metrics.getratelimit_counter.labels(calltype="api").inc(
             pre.n)
         self.metrics.wire_lane_counter.labels(lane="wire_local").inc(
@@ -996,6 +1019,9 @@ class V1Instance:
             raise ValueError(
                 "'PeerRequest.rate_limits' list too large; max size is "
                 f"{self.config.behaviors.batch_limit}")
+        ana = self.dispatcher.analytics
+        if ana is not None:
+            ana.tap_wire_names(data, pre.khash)
         self.metrics.getratelimit_counter.labels(calltype="peer").inc(
             pre.n)
         self.metrics.wire_lane_counter.labels(lane="peer_wire").inc(
@@ -1031,6 +1057,9 @@ class V1Instance:
                 errors = [None] * n
                 for i in np.nonzero(full)[0]:
                     errors[int(i)] = "rate limit table full"
+                    if ana is not None:
+                        ana.tap_flag("errors", 1,
+                                     khash=int(pre.khash[int(i)]))
             t_b = time.perf_counter()
             resp = _wire_native.build_responses_from_columns(
                 (status, lim, rem, rst, full), 0, n, errors)
@@ -1061,11 +1090,45 @@ class V1Instance:
             errors = [None] * n
             for i in np.nonzero(full)[0]:
                 errors[int(i)] = "rate limit table full"
+                if ana is not None:
+                    ana.tap_flag("errors", 1, khash=int(kh[int(i)]))
         t_b = time.perf_counter()
         resp = _wire_native.build_responses_from_columns(
             view.cols, view.lo, view.hi, errors)
         self._obs_phase("build", time.perf_counter() - t_b)
         return resp
+
+    # ---- tenant attribution helpers (ISSUE 11) -------------------------
+
+    def _tenant_of_reqs(self, reqs) -> Optional[str]:
+        """Shed-attribution hint for the object lane.  Only invoked on
+        the exceptional path (admission rejected the batch), so the
+        per-call cost never touches admitted traffic."""
+        ana = self.dispatcher.analytics
+        if ana is None or not reqs:
+            return None
+        try:
+            return ana.tenant_hint(name=reqs[0].name)
+        except Exception:
+            return None
+
+    def _tenant_of_wire(self, data: bytes) -> Optional[str]:
+        """Shed-attribution hint for the wire lanes: tolerant
+        pure-Python TLV walk to the first request's name.  Like
+        ``_tenant_of_reqs`` this only runs when a shed actually fires;
+        admitted wire batches never pay for it."""
+        ana = self.dispatcher.analytics
+        if ana is None:
+            return None
+        try:
+            from .analytics import iter_wire_names
+
+            pairs = iter_wire_names(data)
+            if not pairs:
+                return None
+            return ana.tenant_hint(name=pairs[0][0])
+        except Exception:
+            return None
 
     def get_peer_rate_limits_wire(self, data: bytes,
                                   now_ms: Optional[int] = None) -> bytes:
@@ -1203,9 +1266,17 @@ class V1Instance:
         for addr, cnt in by_addr.items():
             self.metrics.degraded_served.labels(peer_addr=addr).inc(cnt)
         if by_addr:
-            self.recorder.record("degraded", peer=min(by_addr),
-                                 rows=sum(by_addr.values()),
-                                 rehomed=True)
+            rows = sum(by_addr.values())
+            ana = self.dispatcher.analytics
+            tenant = None
+            if ana is not None:
+                kh0 = int(raw[mask][0])
+                tenant = ana.tenant_hint(khash=kh0)
+                ana.tap_flag("degraded", rows, khash=kh0)
+            ev = {"peer": min(by_addr), "rows": rows, "rehomed": True}
+            if tenant is not None:
+                ev["tenant"] = tenant
+            self.recorder.record("degraded", **ev)
         return b"".join(items)
 
     @staticmethod
@@ -1889,7 +1960,16 @@ class V1Instance:
                                                     stamp_ms=now):
             gm.queue_hits_raw(k, tlv, a)
         self.metrics.degraded_served.labels(peer_addr=peer_addr).inc(m)
-        self.recorder.record("degraded", peer=peer_addr, rows=m)
+        ana = self.dispatcher.analytics
+        tenant = None
+        if ana is not None and idxs.size:
+            kh0 = int(kh[idxs][0])
+            tenant = ana.tenant_hint(khash=kh0)
+            ana.tap_flag("degraded", m, khash=kh0)
+        ev = {"peer": peer_addr, "rows": m}
+        if tenant is not None:
+            ev["tenant"] = tenant
+        self.recorder.record("degraded", **ev)
         return out
 
     def _degrade_failed_forward(self, parsed: dict, data: bytes,
@@ -2153,9 +2233,18 @@ class V1Instance:
                         if resp.status == Status.OVER_LIMIT:
                             self.metrics.over_limit_counter.inc()
                     responses[i] = resp
-                self.recorder.record("degraded",
-                                     peer=deg_failed[0][2],
-                                     rows=len(deg_failed))
+                ana = self.dispatcher.analytics
+                tenant = None
+                if ana is not None:
+                    tenant = ana.tenant_hint(
+                        name=deg_failed[0][1].name)
+                    ana.tap_flag("degraded", len(deg_failed),
+                                 tenant=tenant)
+                ev = {"peer": deg_failed[0][2],
+                      "rows": len(deg_failed)}
+                if tenant is not None:
+                    ev["tenant"] = tenant
+                self.recorder.record("degraded", **ev)
             except Exception as e:  # noqa: BLE001 - degraded serve must
                 # never take the batch down; fall back to error rows
                 for i, req, addr in deg_failed:
@@ -2547,6 +2636,13 @@ class V1Instance:
         # the collective's time as its own phase (PhaseLedger)
         self.dispatcher.reconcile_gen = mge.generation
         self.dispatcher._obs_phase("global_fold", dt)
+        self._mesh_last_fold_ok = time.monotonic()
+        ana = self.dispatcher.analytics
+        if ana is not None:
+            # cost-model sample (ISSUE 11): the fold moves the
+            # replicated value columns + accumulator across mge.n
+            # devices — one (bytes, ndev, duration) observation
+            ana.tap_cost("global_fold", mge.fold_nbytes, mge.n, dt)
         if (self._mesh_degraded
                 and time.monotonic() >= self._mesh_down_until):
             # cooldown elapsed AND a clean fold: re-arm the tier
@@ -2795,6 +2891,98 @@ class V1Instance:
             return "unhealthy"
         return "healthy"
 
+    # ---- SLO plane (ISSUE 11) ------------------------------------------
+
+    def _build_slo(self) -> None:
+        """Register the catalog (slo.py › SLO_CATALOG) against this
+        instance's live signals and start the tick loop.  Sources are
+        cheap reads of already-maintained state — the SLO plane adds
+        no work to the serving path."""
+        from .config import parse_duration_ms
+        from .interval import IntervalLoop
+        from .slo import (DEFAULT_BURN_THRESHOLD, DEFAULT_FAST_S,
+                          DEFAULT_SLOW_S, SLO, SLO_CATALOG, SLOEngine)
+
+        def _dur_s(v: str, default_s: float) -> float:
+            if not v:
+                return default_s
+            try:
+                return parse_duration_ms(v) / 1000.0
+            except (ValueError, TypeError):
+                return default_s
+
+        def _flt(v: str, default: float) -> float:
+            try:
+                return float(v or default)
+            except ValueError:
+                return default
+
+        fast = _dur_s(os.environ.get("GUBER_SLO_FAST", ""),
+                      DEFAULT_FAST_S)
+        slow = _dur_s(os.environ.get("GUBER_SLO_SLOW", ""),
+                      DEFAULT_SLOW_S)
+        tick_s = _dur_s(os.environ.get("GUBER_SLO_TICK", ""), 1.0)
+        burn = _flt(os.environ.get("GUBER_SLO_BURN", ""),
+                    DEFAULT_BURN_THRESHOLD)
+        p99_s = _flt(os.environ.get("GUBER_SLO_P99_MS", ""),
+                     250.0) / 1000.0
+        eng = SLOEngine(metrics=self.metrics, recorder=self.recorder,
+                        fast_s=fast, slow_s=slow, burn_threshold=burn)
+        ana = self.dispatcher.analytics
+
+        def decision_p99():
+            p = (ana.phases.recent_p99("device")
+                 if ana is not None else None)
+            return (p or 0.0, p99_s)
+
+        stale_target = 2.0 * max(
+            self.config.behaviors.global_sync_wait_ms, 100) / 1000.0
+
+        def global_staleness():
+            mge = self._meshglobal
+            if mge is None:
+                return (0.0, stale_target)
+            v = float(mge.last_staleness_s)
+            ok = self._mesh_last_fold_ok
+            if ok is not None:
+                # a wedged/failing fold stops updating last_staleness_s
+                # — age against the last SUCCESSFUL fold so the SLO
+                # still sees the coherence gap widening
+                v = max(v, time.monotonic() - ok)
+            return (v, stale_target)
+
+        def error_ratio():
+            t = ana.tenant_totals() if ana is not None else {}
+            return (t.get("errors", 0) + t.get("degraded", 0),
+                    t.get("requests", 0))
+
+        def shed_ratio():
+            t = ana.tenant_totals() if ana is not None else {}
+            return (t.get("shed", 0),
+                    t.get("requests", 0) + t.get("shed", 0))
+
+        eng.register(SLO("decision_p99", "threshold", 0.95,
+                         decision_p99, SLO_CATALOG["decision_p99"]))
+        eng.register(SLO("global_staleness", "threshold", 0.95,
+                         global_staleness,
+                         SLO_CATALOG["global_staleness"]))
+        eng.register(SLO("error_ratio", "ratio", 0.999, error_ratio,
+                         SLO_CATALOG["error_ratio"]))
+        eng.register(SLO("shed_ratio", "ratio", 0.999, shed_ratio,
+                         SLO_CATALOG["shed_ratio"]))
+        if ana is not None:
+            eng.register_group(
+                "tenant_error_ratio", 0.999,
+                lambda: ana.tenant_red("errors"),
+                SLO_CATALOG["tenant_error_ratio"])
+            eng.register_group(
+                "tenant_shed_ratio", 0.999,
+                lambda: ana.tenant_red("shed"),
+                SLO_CATALOG["tenant_shed_ratio"])
+        self.slo = eng
+        self._slo_loop = IntervalLoop(
+            max(int(tick_s * 1000), 10), eng.tick, name="slo-engine")
+
     def health_check(self) -> HealthCheckResponse:
         """reference: gubernator.go › HealthCheck — healthy + peer count,
         surfacing the last async replication error if any."""
@@ -2863,6 +3051,10 @@ class V1Instance:
         if self._closed:
             return
         self._closed = True
+        if self._slo_loop is not None:
+            # first: the close runs one FINAL tick, so the verdicts the
+            # debug dump captures below reflect end-of-life state
+            self._slo_loop.close()
         if self.global_manager is not None:
             self.global_manager.close()
         if self.mr_manager is not None:
@@ -2874,6 +3066,30 @@ class V1Instance:
         self.dispatcher.close()
         if self.dispatcher.analytics is not None:
             self.dispatcher.analytics.close()
+        self._write_debug_dump()
         self._save_to_loader()
         for p in self.peers():
             p.shutdown()
+
+    def _write_debug_dump(self) -> None:
+        """Crash forensics (ISSUE 11): when ``GUBER_DEBUG_DUMP_DIR`` is
+        set, drain dumps the whole event ring plus the final SLO
+        verdicts as JSONL — a killed pod leaves its black box on disk.
+        Best-effort: a dying process must never wedge on forensics."""
+        dirpath = os.environ.get("GUBER_DEBUG_DUMP_DIR", "")
+        if not dirpath:
+            return
+        try:
+            from .telemetry import write_debug_dump
+
+            verdicts = (self.slo.verdicts()
+                        if self.slo is not None else None)
+            iid = (os.environ.get("GUBER_INSTANCE_ID", "")
+                   or self.config.advertise_address or "instance")
+            path = write_debug_dump(
+                dirpath, iid,
+                self.recorder.events(), slo_verdicts=verdicts)
+            self.recorder.record("debug_dump_written", path=path,
+                                 events=len(self.recorder))
+        except Exception as e:  # noqa: BLE001 - forensics is best-effort
+            log.warning("debug dump failed: %s", exc_text(e))

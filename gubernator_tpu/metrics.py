@@ -330,6 +330,41 @@ class Metrics:
             "tier migrations abandoned at the tier_promote/tier_demote "
             "faultpoints (the row stays in its source tier — no state "
             "is lost)", registry=r)
+        # Tenant-aware SLO plane (ISSUE 11): per-tenant RED ledger
+        # gauges (bounded cardinality — GUBER_TENANT_MAX buckets plus
+        # __other__; the analytics worker republishes on its paced
+        # publish tick) and the burn-rate verdict gauge.
+        self.tenant_requests = Gauge(
+            "gubernator_tenant_requests",
+            "rows attributed to this tenant (tenant = key-name prefix "
+            "up to GUBER_TENANT_DELIM; overflow folds into __other__)",
+            ["tenant"], registry=r)
+        self.tenant_hits = Gauge(
+            "gubernator_tenant_hits",
+            "hit weight attributed to this tenant", ["tenant"],
+            registry=r)
+        self.tenant_over_limit = Gauge(
+            "gubernator_tenant_over_limit",
+            "OVER_LIMIT rows attributed to this tenant", ["tenant"],
+            registry=r)
+        self.tenant_errors = Gauge(
+            "gubernator_tenant_errors",
+            "error rows attributed to this tenant", ["tenant"],
+            registry=r)
+        self.tenant_degraded = Gauge(
+            "gubernator_tenant_degraded",
+            "degraded-mode serves attributed to this tenant",
+            ["tenant"], registry=r)
+        self.tenant_shed = Gauge(
+            "gubernator_tenant_shed",
+            "admission-shed rows attributed to the tenant that "
+            "triggered the shed", ["tenant"], registry=r)
+        self.slo_burn = Gauge(
+            "gubernator_slo_burn",
+            "fast-window burn rate per SLO (error-budget spend "
+            "multiple; breach latches when fast AND slow exceed the "
+            "threshold — see GET /debug/slo); tenant label empty for "
+            "instance-level SLOs", ["slo", "tenant"], registry=r)
 
     @contextmanager
     def time_func(self, name: str):

@@ -204,6 +204,13 @@ class MeshGlobalEngine:
         #: pending async fold results: (slot_totals array, launch time)
         self._pending: List[tuple] = []  # guarded-by: self._state_mu
 
+    @property
+    def fold_nbytes(self) -> int:
+        """Per-replica bytes the reconcile collective moves: every
+        TableState value column (int64) plus the retired accumulator
+        — the cost model's (bytes, ndev) feature for global_fold."""
+        return (len(_VALUE_COLS) + 1) * self.capacity * 8
+
     # ---- host slot management (hot-set discipline) ---------------------
 
     def _probe_slots_host(self, key_hash: int) -> List[int]:

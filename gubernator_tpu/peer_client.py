@@ -278,6 +278,12 @@ class _SendLane:
         if client._analytics is not None:
             # the forward hop's share of a request's wall time
             client._analytics.observe_phase("peer_flush", dt)
+            if err is None:
+                # cost-model sample (ISSUE 11): one point-to-point hop
+                # of len(data) wire bytes (failed sends excluded — a
+                # timeout measures the deadline, not the transfer)
+                client._analytics.tap_cost("peer_flush", len(data),
+                                           2, dt)
         if err is not None:
             # lock-free: racy bool read; a retry racing close fails fast next hop
             if (attempt < self.retries and not self._closing

@@ -88,16 +88,20 @@ class FlightRecorder:
 
     def events(self, limit: Optional[int] = None,
                kind: Optional[str] = None,
-               since_seq: Optional[int] = None) -> List[dict]:
+               since_seq: Optional[int] = None,
+               tenant: Optional[str] = None) -> List[dict]:
         """Chronological snapshot (oldest first).  ``kind`` keeps only
-        events of that kind and ``since_seq`` only events with
-        ``seq > since_seq`` (both server-side, so a CLI polling for
-        stalls doesn't re-download the whole ring); ``limit`` then
+        events of that kind, ``since_seq`` only events with
+        ``seq > since_seq``, and ``tenant`` only events carrying that
+        ``tenant`` field (all server-side, so isolating one tenant's
+        incident doesn't download the whole ring); ``limit`` then
         keeps the newest N."""
         with self._mu:
             out = list(self._ring)
         if kind:
             out = [e for e in out if e.get("kind") == kind]
+        if tenant:
+            out = [e for e in out if e.get("tenant") == tenant]
         if since_seq is not None:
             out = [e for e in out if e.get("seq", 0) > since_seq]
         if limit is not None and limit >= 0:
@@ -107,3 +111,32 @@ class FlightRecorder:
     def __len__(self) -> int:
         with self._mu:
             return len(self._ring)
+
+
+def write_debug_dump(dirpath: str, instance_id: str,
+                     events: List[dict],
+                     slo_verdicts: Optional[List[dict]] = None,
+                     clock=time.time) -> str:
+    """Crash-forensics dump (ISSUE 11): one JSONL file per drain —
+    header line with the final SLO verdicts, then the whole event
+    ring — so a killed pod leaves a post-mortem artifact in
+    ``GUBER_DEBUG_DUMP_DIR``.  Returns the written path.  Callers
+    (instance.close) treat any failure as best-effort: a dying
+    process must never wedge on its own black box."""
+    import json
+    import os
+
+    os.makedirs(dirpath, exist_ok=True)
+    t_ms = int(clock() * 1000)
+    safe = "".join(c if c.isalnum() or c in "-._" else "_"
+                   for c in str(instance_id)) or "instance"
+    path = os.path.join(dirpath, f"guber_dump_{safe}_{t_ms}.jsonl")
+    header = {"kind": "dump_header", "t_ms": t_ms,
+              "instance": str(instance_id), "events": len(events)}
+    if slo_verdicts is not None:
+        header["slo_verdicts"] = slo_verdicts
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(header) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return path

@@ -27,6 +27,12 @@ Usage::
     python tools/chaos_matrix.py [--json out.json] [--verbose]
     make chaos
 
+The full matrix additionally runs the SLO breach→recover cells
+(ISSUE 11): a sustained ``global_psum`` delay must latch a
+``global_staleness`` breach and clear it after repair, and sustained
+``peer_send`` faults must do the same for ``error_ratio`` — the chaos
+proof that the burn-rate plane sees what the fault plane injects.
+
 Exit 0 when every exercised cell is ok; 1 otherwise.  Tier-1-safe:
 in-proc daemons, loopback only, a few seconds of wall time
 (tests/test_resilience.py runs a smoke of the same harness).
@@ -410,6 +416,150 @@ MATRIX = {
 MODES = ("error", "delay")
 
 
+# ---- SLO breach→recover cells (ISSUE 11) -----------------------------------
+# The point×mode matrix proves a fault can't wedge the daemon; these
+# cells prove the SLO plane SEES a sustained fault and un-sees its
+# repair: the burn-rate engine must latch a breach while the fault
+# holds and emit the matching recovery once it clears.  Run on the
+# full matrix only (`make chaos`) — they cost real wall time (burn
+# windows are wall-clock even at the 1s/2s chaos settings).
+
+#: wall-clock window overrides for the SLO cells: tight enough that a
+#: breach latches within a couple of folds and recovery within ~2 s
+_SLO_ENV = {"GUBER_SLO_FAST": "1s", "GUBER_SLO_SLOW": "2s",
+            "GUBER_SLO_TICK": "100ms", "GUBER_SLO_P99_MS": "60000"}
+
+
+def _slo_events(inst, kind: str, slo: str) -> bool:
+    return any(e.get("kind") == kind and e.get("slo") == slo
+               for e in inst.recorder.events())
+
+
+def _slo_staleness_cell() -> dict:
+    """global_psum:delay → mesh-GLOBAL folds run late → measured
+    coherence staleness exceeds 2× the reconcile interval →
+    ``global_staleness`` breaches; clearing the fault and folding
+    cleanly must emit ``slo_recovered``."""
+    from gubernator_tpu.config import BehaviorConfig, Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.types import Behavior
+
+    spec = "global_psum:delay:400ms"
+    cell = {"cell": "slo_staleness", "slo": "global_staleness",
+            "spec": spec}
+    t0 = time.perf_counter()
+    inst = V1Instance(Config(
+        global_mode="mesh",
+        behaviors=BehaviorConfig(global_sync_wait_ms=100)))
+    try:
+        def drive():
+            inst.get_rate_limits_wire(_one(
+                "slokey", behavior=int(Behavior.GLOBAL)), now_ms=NOW0)
+            inst._mesh_reconcile_tick()
+            inst.slo.tick()
+
+        drive()  # clean fold: the healthy baseline sample
+        inst.faults.arm(spec, seed=7)
+        deadline = time.monotonic() + 15.0
+        breached = False
+        while time.monotonic() < deadline and not breached:
+            drive()  # each fold lands ≥400ms stale (target: 200ms)
+            breached = _slo_events(inst, "slo_breach",
+                                   "global_staleness")
+        inst.faults.clear()
+        recovered = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and breached and not recovered:
+            drive()  # clean folds: staleness back under target
+            recovered = _slo_events(inst, "slo_recovered",
+                                    "global_staleness")
+            time.sleep(0.1)  # let the bad ticks age out of the window
+    finally:
+        inst.close()
+    cell.update({"breached": breached, "recovered": recovered,
+                 "elapsed_ms": round((time.perf_counter() - t0) * 1000,
+                                     1),
+                 "ok": breached and recovered})
+    return cell
+
+
+def _slo_error_ratio_cell() -> dict:
+    """peer_send:error → every forwarded row degrades (or errors) →
+    ``error_ratio`` burns past threshold and breaches; clearing the
+    fault and serving clean traffic must emit ``slo_recovered``."""
+    from gubernator_tpu import cluster as cluster_mod
+    from gubernator_tpu.config import BehaviorConfig
+
+    spec = "peer_send:error"
+    cell = {"cell": "slo_error_ratio", "slo": "error_ratio",
+            "spec": spec}
+    t0 = time.perf_counter()
+    c = cluster_mod.start(2, behaviors=BehaviorConfig(
+        batch_timeout_ms=300, batch_wait_ms=50,
+        peer_retry_limit=1, peer_retry_backoff_ms=5,
+        peer_circuit_threshold=2, peer_circuit_cooldown_ms=200))
+    try:
+        i0 = c.instance_at(0)
+        remote = local = None
+        for i in range(200):
+            k = f"sk{i}"
+            owner = c.owner_daemon_of("chaos_" + k)
+            if owner is c.daemon_at(1) and remote is None:
+                remote = k
+            if owner is c.daemon_at(0) and local is None:
+                local = k
+            if remote and local:
+                break
+        ana = i0.dispatcher.analytics
+
+        def drive(key):
+            i0.get_rate_limits_wire(_one(key), now_ms=NOW0)
+            if ana is not None:
+                ana.flush(timeout=2.0)  # land the RED taps
+            i0.slo.tick()
+
+        drive(local)  # clean baseline sample
+        i0.faults.arm(spec, seed=7)
+        deadline = time.monotonic() + 15.0
+        breached = False
+        while time.monotonic() < deadline and not breached:
+            drive(remote)  # forwarded row degrades/errors
+            breached = _slo_events(i0, "slo_breach", "error_ratio")
+        i0.faults.clear()
+        recovered = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and breached and not recovered:
+            drive(local)  # clean rows dilute + age out the window
+            recovered = _slo_events(i0, "slo_recovered", "error_ratio")
+            time.sleep(0.1)
+    finally:
+        c.stop()
+    cell.update({"breached": breached, "recovered": recovered,
+                 "elapsed_ms": round((time.perf_counter() - t0) * 1000,
+                                     1),
+                 "ok": breached and recovered})
+    return cell
+
+
+def run_slo_cells(verbose=False) -> list:
+    old = {k: os.environ.get(k) for k in _SLO_ENV}
+    os.environ.update(_SLO_ENV)
+    cells = []
+    try:
+        for fn in (_slo_staleness_cell, _slo_error_ratio_cell):
+            cell = fn()
+            cells.append(cell)
+            if verbose:
+                print(json.dumps(cell), file=sys.stderr)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return cells
+
+
 def run_matrix(points=None, verbose=False) -> dict:
     from gubernator_tpu.faults import FAULT_POINTS, FaultInjected
 
@@ -460,15 +610,21 @@ def run_matrix(points=None, verbose=False) -> dict:
                     print(json.dumps(cell), file=sys.stderr)
     finally:
         ctx.close()
+    # SLO breach→recover cells ride the FULL matrix only (`make
+    # chaos`): a --point / smoke subset stays fast
+    slo_cells = run_slo_cells(verbose=verbose) if not points else []
     exercised = [c for c in cells if c["outcome"] != "not_reached"]
     return {
         "cells": cells,
+        "slo_cells": slo_cells,
         "exercised": len(exercised),
         "not_reached": [f"{c['point']}:{c['mode']}" for c in cells
                         if c["outcome"] == "not_reached"],
-        "failed": [f"{c['point']}:{c['mode']}" for c in cells
-                   if not c["ok"]],
-        "ok": all(c["ok"] for c in cells),
+        "failed": ([f"{c['point']}:{c['mode']}" for c in cells
+                    if not c["ok"]]
+                   + [c["cell"] for c in slo_cells if not c["ok"]]),
+        "ok": (all(c["ok"] for c in cells)
+               and all(c["ok"] for c in slo_cells)),
     }
 
 

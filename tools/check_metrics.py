@@ -19,7 +19,11 @@ Asserts, against a fresh ``Metrics()`` registry:
    catalog ↔ doc — together the chaos surface can't drift anywhere);
 6. CONCURRENCY.md's GUBER_* table matches config.ENV_REGISTRY both
    ways (guberlint's ``envreg`` pass pins registry ↔ code), and its
-   lock-hierarchy table names every lock in guberlint's LOCK_ORDER.
+   lock-hierarchy table names every lock in guberlint's LOCK_ORDER;
+7. OBSERVABILITY.md's "SLO catalog & burn windows" table matches
+   slo.SLO_CATALOG both ways — the declarative SLO registry is an
+   operator contract, so an SLO that exists but isn't documented (or
+   a documented one that was removed) fails tier-1.
 
 Exit 0 when clean; prints each violation and exits 1 otherwise.
 """
@@ -127,6 +131,26 @@ def faultpoint_doc_problems() -> list:
     return problems
 
 
+def slo_catalog_doc_problems() -> list:
+    """OBSERVABILITY.md's SLO table ↔ slo.SLO_CATALOG, both ways."""
+    from gubernator_tpu.slo import SLO_CATALOG
+
+    with open(DOC, encoding="utf-8") as f:
+        doc = f.read()
+    documented = _table_cell_names(doc, "## SLO catalog & burn windows",
+                                   r"`([a-z0-9_]+)`")
+    problems = []
+    for name in sorted(set(SLO_CATALOG) - documented):
+        problems.append(
+            f"SLO {name!r} is in slo.SLO_CATALOG but missing from "
+            f"OBSERVABILITY.md's SLO catalog table")
+    for name in sorted(documented - set(SLO_CATALOG)):
+        problems.append(
+            f"OBSERVABILITY.md's SLO catalog table documents {name!r} "
+            f"but slo.SLO_CATALOG has no such SLO")
+    return problems
+
+
 def env_registry_doc_problems() -> list:
     """CONCURRENCY.md's GUBER_* table ↔ config.ENV_REGISTRY, plus its
     lock-hierarchy table ↔ guberlint's LOCK_ORDER."""
@@ -203,6 +227,7 @@ def main() -> int:
 
     problems += faultpoint_doc_problems()
     problems += env_registry_doc_problems()
+    problems += slo_catalog_doc_problems()
 
     if problems:
         for p in problems:

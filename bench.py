@@ -809,6 +809,89 @@ def _faults_ab(inst, call, pairs=5, reps=30) -> dict:
             pass
 
 
+def _tracing_ab(inst, call, pairs=5, reps=30) -> dict:
+    """ISSUE 12 acceptance: the trace plane must stay off the hot path
+    — armed-but-unsampled (the shipping default, GUBER_TRACE_SAMPLE=0)
+    < 1% on the service path, 1%-sampled < 3%.
+
+    Interleaved timing pairs of the same call in three states: *off*
+    (span recorder detached from the dispatcher AND the request
+    context — the pre-instrumentation proxy), *armed* (recorder
+    attached, sample=0: every request pays span buffering + a
+    commit-and-drop), and *sampled* (sample=0.01: the realistic prod
+    rate, ~1 in 100 traces retained into the ring).  Every arm wraps
+    the call in ``tracing.request_context`` so the trace-id plumbing
+    itself (pre-ISSUE 12 behavior) is in the baseline; only the span
+    plane toggles.  Same alternating-order median-of-ratios discipline
+    as ``_faults_ab``."""
+    from gubernator_tpu.tracing import request_context
+
+    disp = inst.dispatcher
+    rec = inst.span_recorder
+    old_sample = rec.sample
+
+    state = {"rec": None}
+
+    def rate():
+        r_ctx = state["rec"]
+        t0 = time.perf_counter()
+        for r in range(reps):
+            with request_context(None, recorder=r_ctx):
+                call(r)
+        return reps / (time.perf_counter() - t0)
+
+    def _state(which):
+        if which == "off":
+            disp.span_recorder = None
+            state["rec"] = None
+            return
+        disp.span_recorder = rec
+        state["rec"] = rec
+        rec.sample = 0.01 if which == "smp" else 0.0
+
+    def _measure(which):
+        _state(which)
+        try:
+            return rate()
+        finally:
+            _state("off")
+
+    try:
+        r_off, r_arm, r_smp = [], [], []
+        for pair in range(pairs + 1):
+            # alternate order per pair so monotonic host drift cancels
+            # in the per-pair ratios instead of biasing them
+            order = (("off", "arm", "smp") if pair % 2
+                     else ("smp", "arm", "off"))
+            got = {w: _measure(w) for w in order}
+            if pair == 0:
+                continue  # warmup pair, untimed
+            r_off.append(got["off"])
+            r_arm.append(got["arm"])
+            r_smp.append(got["smp"])
+        armed = (float(np.median([o / a for o, a
+                                  in zip(r_off, r_arm)])) - 1) * 100
+        sampled = (float(np.median([o / s for o, s
+                                    in zip(r_off, r_smp)])) - 1) * 100
+        row = {"armed_overhead_pct": round(armed, 2),
+               "overhead_ok": bool(armed < 1.0),
+               "sampled_overhead_pct": round(sampled, 2),
+               "sampled_ok": bool(sampled < 3.0),
+               "off_calls_per_s": round(float(np.median(r_off)), 1),
+               "pairs": pairs, "reps": reps}
+        if not (row["overhead_ok"] and row["sampled_ok"]):
+            row["warning"] = ("trace plane measured above budget "
+                              "(armed<1% / 1%-sampled<3%) on this "
+                              "run; single-host noise — re-run "
+                              "before acting on it")
+        return row
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+    finally:
+        disp.span_recorder = rec
+        rec.sample = old_sample
+
+
 def _serialize_reqs(reqs_lists):
     """[[RateLimitRequest]] → serialized GetRateLimitsReq bytes."""
     from gubernator_tpu.proto import gubernator_pb2 as pb
@@ -1135,6 +1218,15 @@ def _sec_svc():
                     datas[r % 4], now_ms=NOW0 + 700 + r))
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["faults_ab"] = {
+                "error": (str(e) or repr(e))[:200]}
+        # ISSUE 12 acceptance: trace-plane overhead A/B on the same
+        # wire-lane call (armed-unsampled <1%, 1%-sampled <3%)
+        try:
+            out["6_service_path"]["tracing_ab"] = _tracing_ab(
+                inst, lambda r: inst.get_rate_limits_wire(
+                    datas[r % 4], now_ms=NOW0 + 800 + r))
+        except Exception as e:  # noqa: BLE001
+            out["6_service_path"]["tracing_ab"] = {
                 "error": (str(e) or repr(e))[:200]}
         _section_checkpoint(out)
         # peer-forwarding path: what the owner-side apply of a

@@ -839,8 +839,14 @@ class KeyAnalytics:
 
     # ---- phase attribution ---------------------------------------------
 
-    def observe_phase(self, phase: str, seconds: float) -> None:
-        """One phase sample → histogram + /debug/phases ledger."""
+    def observe_phase(self, phase: str, seconds: float,
+                      exemplar=None) -> None:
+        """One phase sample → histogram + /debug/phases ledger.
+        ``exemplar`` (ISSUE 12): a recent sampled trace's label dict,
+        attached to the histogram observation so a slow-phase bucket
+        links to one concrete trace (openmetrics exposition)."""
+        from .metrics import observe_with_exemplar
+
         seconds = max(seconds, 0.0)
         self.phases.observe(phase, seconds)
         m = self.metrics
@@ -849,7 +855,7 @@ class KeyAnalytics:
             if child is None:  # benign race: labels() is idempotent
                 child = self._phase_hist[phase] = \
                     m.phase_duration.labels(phase=phase)
-            child.observe(seconds)
+            observe_with_exemplar(child, seconds, exemplar)
 
     # ---- worker ---------------------------------------------------------
 

@@ -47,8 +47,28 @@ def _debug_main(argv) -> int:
                     help="only events of this kind (e.g. wave_stalled)")
     ev.add_argument("--since-seq", type=int, default=0,
                     help="only events with seq > N (incremental polls)")
+    ev.add_argument("--trace", default="",
+                    help="only events stamped with this trace id "
+                         "(server-side filter)")
     ev.add_argument("--timeout", type=float, default=10.0)
     ev.add_argument("--json", action="store_true",
+                    help="print the raw JSON document")
+    tr = sub.add_parser("traces",
+                        help="dump the daemon's span-recorder ring "
+                             "(/debug/traces), assembled per trace")
+    tr.add_argument("--url", action="append", dest="urls", default=None,
+                    help="daemon HTTP base url (default "
+                         "http://localhost:1050); repeat to stitch "
+                         "several daemons' slices into one tree")
+    tr.add_argument("--trace-id", default="",
+                    help="only spans of this trace (server-side)")
+    tr.add_argument("--limit", type=int, default=0,
+                    help="only the newest N spans per daemon")
+    tr.add_argument("--waterfall", action="store_true",
+                    help="render each assembled trace as a text "
+                         "waterfall")
+    tr.add_argument("--timeout", type=float, default=10.0)
+    tr.add_argument("--json", action="store_true",
                     help="print the raw JSON document")
     tk = sub.add_parser("topkeys",
                         help="dump the daemon's heavy-hitter key "
@@ -103,6 +123,8 @@ def _debug_main(argv) -> int:
         return _debug_slo(args)
     if args.what == "faults":
         return _debug_faults(args)
+    if args.what == "traces":
+        return _debug_traces(args)
 
     url = args.url
     if "/debug/events" not in url:
@@ -120,6 +142,8 @@ def _debug_main(argv) -> int:
         _q(f"kind={args.kind}")
     if args.since_seq > 0:
         _q(f"since_seq={args.since_seq}")
+    if args.trace:
+        _q(f"trace={args.trace}")
     try:
         body = _fetch_json(url, args.timeout)
     except Exception as e:  # noqa: BLE001
@@ -144,6 +168,55 @@ def _debug_main(argv) -> int:
         print(line)
     if not events:
         print("(no events)", file=sys.stderr)
+    return 0
+
+
+def _debug_traces(args) -> int:
+    urls = args.urls or ["http://localhost:1050"]
+    spans, meta = [], []
+    for base in urls:
+        url = base
+        if "/debug/traces" not in url:
+            url = url.rstrip("/") + "/debug/traces"
+        if args.trace_id:
+            url += ("&" if "?" in url else "?") + f"trace_id={args.trace_id}"
+        if args.limit > 0:
+            url += ("&" if "?" in url else "?") + f"limit={args.limit}"
+        try:
+            body = _fetch_json(url, args.timeout)
+        except Exception as e:  # noqa: BLE001
+            print(f"fetch failed ({base}): {e!r}", file=sys.stderr)
+            return 1
+        spans.extend(body.get("spans", []))
+        meta.append({k: body.get(k) for k in ("sample", "capacity", "dropped")})
+    if args.json:
+        print(json.dumps({"daemons": meta, "spans": spans}))
+        return 0
+    from ..tracing import assemble, render_waterfall
+    traces = assemble(spans, trace_id=args.trace_id or None)
+    if not traces:
+        print("(no spans)", file=sys.stderr)
+        return 0
+    for trace in traces:
+        if args.waterfall:
+            print(render_waterfall(trace))
+            print()
+            continue
+        tid = trace["trace_id"]
+        print(f"trace {tid}: {trace['spans']} span(s)")
+
+        def _walk(node, depth):
+            dur_ms = (node["end"] - node["start"]) * 1e3
+            line = (f"  {'  ' * depth}{node['name']} "
+                    f"[{node['span_id']}] {dur_ms:.3f}ms")
+            if node.get("attrs"):
+                line += " " + json.dumps(node["attrs"], sort_keys=True)
+            print(line)
+            for c in node.get("children", []):
+                _walk(c, depth + 1)
+
+        for root in trace["roots"]:
+            _walk(root, 0)
     return 0
 
 

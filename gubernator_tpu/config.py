@@ -131,6 +131,8 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_TLS_INSECURE_SKIP_VERIFY": "peer clients skip TLS verification",
     "GUBER_TLS_KEY": "TLS server key path",
     "GUBER_TOPK": "heavy-hitter sketch tracked-key count K",
+    "GUBER_TRACE_SAMPLE": "head-sampling rate for the trace plane (0 disables)",
+    "GUBER_TRACE_SPANS": "span-recorder ring capacity (completed spans kept)",
     "GUBER_WAVE_BUCKETS": "comma-separated wave-size buckets for check_packed",
     "GUBER_XLA_CPU_TUNE": "0 skips the XLA:CPU thunk-runtime opt-out at import",
 }

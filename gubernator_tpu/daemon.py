@@ -47,7 +47,8 @@ class _V1Servicer:
         self.instance = instance
 
     def GetRateLimits(self, request: pb.GetRateLimitsReq, context):
-        with grpc_request_context(context), \
+        with grpc_request_context(
+                context, recorder=self.instance.span_recorder), \
                 span("grpc.GetRateLimits", metrics=self.instance.metrics), \
                 request_deadline(context.time_remaining()):
             try:
@@ -67,7 +68,8 @@ class _V1Servicer:
         lets the instance's C++ wire lane run decode→decide→encode
         without pb2 when the batch qualifies.  The caller's remaining
         deadline scopes deadline-aware admission shedding (ISSUE 5)."""
-        with grpc_request_context(context), \
+        with grpc_request_context(
+                context, recorder=self.instance.span_recorder), \
                 span("grpc.GetRateLimits", metrics=self.instance.metrics), \
                 request_deadline(context.time_remaining()):
             try:
@@ -88,7 +90,8 @@ class _PeersServicer:
 
     def GetPeerRateLimits(self, request: peers_pb.GetPeerRateLimitsReq,
                           context):
-        with grpc_request_context(context), \
+        with grpc_request_context(
+                context, recorder=self.instance.span_recorder), \
                 span("grpc.GetPeerRateLimits",
                      metrics=self.instance.metrics):
             try:
@@ -102,7 +105,8 @@ class _PeersServicer:
 
     def GetPeerRateLimitsWire(self, request: bytes, context):
         """Raw-bytes twin of GetPeerRateLimits (C++ wire lane)."""
-        with grpc_request_context(context), \
+        with grpc_request_context(
+                context, recorder=self.instance.span_recorder), \
                 span("grpc.GetPeerRateLimits",
                      metrics=self.instance.metrics), \
                 request_deadline(context.time_remaining()):
@@ -116,7 +120,8 @@ class _PeersServicer:
 
     def UpdatePeerGlobals(self, request: peers_pb.UpdatePeerGlobalsReq,
                           context):
-        with grpc_request_context(context), \
+        with grpc_request_context(
+                context, recorder=self.instance.span_recorder), \
                 span("grpc.UpdatePeerGlobals",
                      metrics=self.instance.metrics):
             self.instance.update_peer_globals(list(request.globals))
@@ -344,9 +349,10 @@ class Daemon:
                     self._send(code, json.dumps(body).encode())
                 elif path == "/debug/events":
                     # flight recorder ring (telemetry.py), newest-last;
-                    # ?limit=N keeps only the newest N events; ?kind=K
-                    # and ?since_seq=S filter SERVER-side so a polling
-                    # CLI doesn't re-download the whole ring
+                    # ?limit=N keeps only the newest N events; ?kind=K,
+                    # ?since_seq=S, ?tenant=T and ?trace=ID filter
+                    # SERVER-side so a polling CLI doesn't re-download
+                    # the whole ring
                     try:
                         limit = int(q.get("limit", ["0"])[-1]) or None
                     except ValueError:
@@ -357,10 +363,34 @@ class Daemon:
                     except ValueError:
                         since = None
                     tenant = q.get("tenant", [""])[-1] or None
+                    trace = q.get("trace", [""])[-1] or None
                     self._send(200, json.dumps({
                         "events": daemon.instance.recorder.events(
-                            limit=limit, kind=kind,
-                            since_seq=since, tenant=tenant)}).encode())
+                            limit=limit, kind=kind, since_seq=since,
+                            tenant=tenant, trace=trace)}).encode())
+                elif path == "/debug/traces":
+                    # trace plane (ISSUE 12, tracing.py): the span
+                    # recorder's committed ring as JSON — one daemon's
+                    # SLICE of each trace; tools/trace_assemble.py (or
+                    # guber-cli debug traces --waterfall) stitches N
+                    # daemons' slices into the cluster-wide tree
+                    rec = daemon.instance.span_recorder
+                    if rec is None:
+                        self._send(404, json.dumps(
+                            {"error": "tracing disabled"}).encode())
+                        return
+                    try:
+                        limit = int(q.get("limit", ["0"])[-1]) or None
+                    except ValueError:
+                        limit = None
+                    tid = q.get("trace_id", [""])[-1] or None
+                    st = rec.stats()
+                    body = {"sample": st["sample"],
+                            "capacity": st["capacity"],
+                            "dropped": st["dropped"],
+                            "spans": rec.spans(trace_id=tid,
+                                               limit=limit)}
+                    self._send(200, json.dumps(body).encode())
                 elif path == "/debug/topkeys":
                     # heavy-hitter ledger (analytics.py): the current
                     # top-K keys with hits / over-limit / error bound /
@@ -474,7 +504,11 @@ class Daemon:
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     reqs = [_json_to_req(o)
                             for o in payload.get("requests", [])]
-                    with request_context(self.headers.get("traceparent")):
+                    with request_context(
+                            self.headers.get("traceparent"),
+                            recorder=daemon.instance.span_recorder), \
+                            span("http.GetRateLimits",
+                                 metrics=daemon.instance.metrics):
                         resps = daemon.instance.get_rate_limits(reqs)
                 except ValueError as e:
                     self._send(400, json.dumps(

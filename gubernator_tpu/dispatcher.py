@@ -105,15 +105,17 @@ class ResultView:
 
 
 class _Job:
-    __slots__ = ("reqs", "now_ms", "future", "t_enq", "trace")
+    __slots__ = ("reqs", "now_ms", "future", "t_enq", "trace", "span")
 
     def __init__(self, reqs, now_ms):
         self.reqs = reqs
         self.now_ms = now_ms
         self.future: Future = Future()
         #: stamped by _submit: queue-wait start + caller's trace id
+        #: (+ the caller's open span id, the wave span's parent)
         self.t_enq: Optional[float] = None
         self.trace: Optional[str] = None
+        self.span: Optional[str] = None
 
 
 class _PackedJob:
@@ -124,7 +126,7 @@ class _PackedJob:
     serve both lanes in ONE launch."""
 
     __slots__ = ("batch", "khash", "now_ms", "future", "t_enq", "trace",
-                 "mslot")
+                 "span", "mslot")
 
     def __init__(self, batch, khash, now_ms, mslot=None):
         self.batch = batch
@@ -134,6 +136,7 @@ class _PackedJob:
         self.future: Future = Future()
         self.t_enq: Optional[float] = None
         self.trace: Optional[str] = None
+        self.span: Optional[str] = None
 
 
 def _concat_mslot(jobs):
@@ -179,6 +182,10 @@ class Dispatcher:
     #: overrides; 0 disables the bound (deadline/drain shed remain).
     ADMISSION_LIMIT_WAVES = 8
 
+    #: fan-in link bound (ISSUE 12): a wave span records at most this
+    #: many OTHER batched requests' (trace, span) pairs as attributes
+    WAVE_LINKS = 8
+
     def __init__(self, engine, max_wave: int = 8192,
                  max_delay_ms: float = 0.2,
                  lock: Optional[threading.Lock] = None,
@@ -220,6 +227,11 @@ class Dispatcher:
         #: library use) pays only the cheap internal counters.
         self.metrics = metrics
         self.recorder = recorder
+        #: optional tracing.SpanRecorder (ISSUE 12): when attached (by
+        #: the instance), every wave emits a fan-in span + exact phase
+        #: child spans; None (bare dispatchers, bench "off" arm) costs
+        #: nothing.  Plain attr — swapped whole, racy reads are fine.
+        self.span_recorder = None
         self._clock = clock
         #: mesh-GLOBAL reconcile generation (ISSUE 7): bumped by the
         #: instance after each collective fold; every wave is stamped
@@ -501,6 +513,11 @@ class Dispatcher:
 
     def _shed(self, reason: str, nrows: int,
               tenant_cb=None) -> None:
+        from .tracing import current_span_id, force_sample
+
+        # a shed outcome must survive head sampling (ISSUE 12): the
+        # rejected caller's trace is exactly the one worth keeping
+        force_sample("shed")
         if self.metrics is not None:
             self.metrics.admission_shed.labels(reason=reason).inc(nrows)
         # tenant attribution (ISSUE 11): resolved LAZILY — only sheds
@@ -528,6 +545,9 @@ class Dispatcher:
                   "queued_rows": self._queued_rows}  # lock-free: diagnostic snapshot
             if tenant is not None:
                 ev["tenant"] = tenant
+            sid = current_span_id()
+            if sid is not None:
+                ev["span_id"] = sid
             self.recorder.record("admission_shed", **ev)
         raise ResourceExhausted(
             f"admission control shed {nrows} requests ({reason}: "
@@ -596,13 +616,14 @@ class Dispatcher:
         self._draining = True
 
     def _submit(self, job) -> None:
-        from .tracing import current_trace_id
+        from .tracing import current_span_id, current_trace_id
 
         self._fault("dispatch_enqueue")
         n = _job_len(job)
         self.admit(n)
         job.t_enq = self._clock()
         job.trace = current_trace_id()
+        job.span = current_span_id()
         with self._submit_mu:
             # checked under the same lock close() takes, so a job can
             # never slip into the queue after the final drain
@@ -626,6 +647,8 @@ class Dispatcher:
                     tenant: Optional[str] = None) -> int:
         t0 = self._clock()
         waits = []
+        parent = None
+        links = []
         if jobs:
             nreq = sum(_job_len(j) for j in jobs)
             for j in jobs:
@@ -633,12 +656,24 @@ class Dispatcher:
                     waits.append(max(t0 - j.t_enq, 0.0))
                 if trace is None:
                     trace = j.trace
+                    parent = getattr(j, "span", None)
+                elif self.span_recorder is not None and j.trace \
+                        and len(links) < self.WAVE_LINKS:
+                    # fan-in: every OTHER request batched into this
+                    # wave, linked by (trace, span) pairs (bounded)
+                    links.append(f"{j.trace}:{getattr(j, 'span', '') or ''}")
         elif trace is None:
             # inline wave: the caller thread IS the request handler, so
             # its trace context is live right here
-            from .tracing import current_trace_id
+            from .tracing import current_span_id, current_trace_id
 
             trace = current_trace_id()
+            parent = current_span_id()
+        wspan = None
+        if self.span_recorder is not None and trace is not None:
+            from .tracing import new_span_id
+
+            wspan = new_span_id()
         if tenant is None and jobs and self.recorder is not None:
             # event-field hint only (one dict probe / prefix split,
             # first job names the wave) — ledger attribution happens
@@ -651,7 +686,8 @@ class Dispatcher:
             self._inflight[wid] = {"t0": t0, "kind": kind, "size": nreq,
                                    "trace": trace, "stalled": False,
                                    "slot": slot, "gen": gen,
-                                   "tenant": tenant,
+                                   "tenant": tenant, "span": wspan,
+                                   "parent": parent, "links": links,
                                    "marks": []}
             self._recent_sizes.append(nreq)
             self._recent_waits.extend(waits)
@@ -665,6 +701,8 @@ class Dispatcher:
         if self.recorder is not None:
             ev = {"trace": trace, "wave": wid, "wave_kind": kind,
                   "size": nreq, "jobs": len(jobs) if jobs else 1}
+            if wspan is not None:
+                ev["span_id"] = wspan
             if gen:
                 # mesh-GLOBAL coherence epoch this wave served under
                 ev["gen"] = gen
@@ -747,19 +785,23 @@ class Dispatcher:
         return self.engine.check_packed(batch, khash, now_ms,
                                         mslot=mslot)
 
-    def _obs_phase(self, phase: str, seconds: float) -> None:
+    def _obs_phase(self, phase: str, seconds: float,
+                   exemplar=None) -> None:
         """One phase sample → histogram (+ the analytics ledger when
         attached; KeyAnalytics.observe_phase already feeds the same
-        histogram, so don't double-observe)."""
+        histogram, so don't double-observe).  ``exemplar`` links the
+        bucket to a recent sampled trace (ISSUE 12)."""
         ana = self.analytics
         if ana is not None:
-            ana.observe_phase(phase, seconds)
+            ana.observe_phase(phase, seconds, exemplar=exemplar)
         elif self.metrics is not None:
+            from .metrics import observe_with_exemplar
+
             child = self._phase_hist.get(phase)
             if child is None:  # benign race: labels() is idempotent
                 child = self._phase_hist[phase] = \
                     self.metrics.phase_duration.labels(phase=phase)
-            child.observe(max(seconds, 0.0))
+            observe_with_exemplar(child, max(seconds, 0.0), exemplar)
 
     def _tap_packed(self, khash, hits, status) -> None:
         """Post-wave columnar tap (None-guarded, never raises into the
@@ -803,6 +845,8 @@ class Dispatcher:
         # segment the wave into its phases (marks stamp segment ENDS;
         # the tail is "resolve") and observe each — off the _tel_mu
         # lock, still before any caller resumes from this wave
+        sr = self.span_recorder
+        ex = sr.exemplar() if sr is not None else None
         phases = None
         marks = info.get("marks")
         if marks:
@@ -813,9 +857,13 @@ class Dispatcher:
                 prev = tm
             phases["resolve"] = max(t1 - prev, 0.0)
             for name, secs in phases.items():
-                self._obs_phase(name, secs)
+                self._obs_phase(name, secs, exemplar=ex)
+        if sr is not None and info.get("span") and info["trace"]:
+            self._record_wave_span(sr, wid, info, dur, phases, error)
         if self.metrics is not None:
-            self.metrics.wave_duration.observe(dur)
+            from .metrics import observe_with_exemplar
+
+            observe_with_exemplar(self.metrics.wave_duration, dur, ex)
             self.metrics.waves_in_flight.dec()
             if first:
                 self.metrics.first_wave_duration.set(dur)
@@ -832,6 +880,8 @@ class Dispatcher:
             ev = {"trace": info["trace"], "wave": wid,
                   "wave_kind": info["kind"], "size": info["size"],
                   "duration_ms": round(dur * 1000, 3)}
+            if info.get("span"):
+                ev["span_id"] = info["span"]
             if info.get("gen"):
                 ev["gen"] = info["gen"]
             if info.get("slot") is not None:
@@ -852,6 +902,57 @@ class Dispatcher:
                 # the warmup didn't cover (cold tunnel: 250-305 s)
                 self.recorder.record("first_wave", trace=info["trace"],
                                      duration_ms=round(dur * 1000, 3))
+
+    def _record_wave_span(self, sr, wid: int, info: dict, dur: float,
+                          phases, error) -> None:
+        """Emit the wave's fan-in span + its phase child spans
+        (ISSUE 12).  The wave clock is monotonic (`_clock`); spans
+        carry wall time, so the wave is reconstructed backwards from
+        `now`: children laid end-to-end in mark order EXACTLY
+        partition the wave span — the PhaseLedger partition, kept, as
+        tree structure.  Never raises into the serving path."""
+        try:
+            import time as _time
+
+            total = sum(phases.values()) if phases else dur
+            start = _time.time() - total
+            # lay the children end-to-end FIRST and take the wave's
+            # end from the same cumulative walk — bitwise-exact
+            # partition (start + sum(...) differs in the last float
+            # bits from the accumulated chain)
+            c = start
+            kids = []
+            for name, secs in (phases or {}).items():
+                kids.append((name, c, c + secs))
+                c += secs
+            end = c if kids else start + total
+            tid = info["trace"]
+            attrs = {"wave": wid, "kind": info["kind"],
+                     "size": info["size"]}
+            if info.get("gen"):
+                attrs["gen"] = info["gen"]
+            if info.get("slot") is not None:
+                attrs["slot"] = info["slot"]
+            if info.get("tenant") is not None:
+                attrs["tenant"] = info["tenant"]
+            if info.get("links"):
+                attrs["links"] = ",".join(info["links"])
+            if error is not None:
+                from .telemetry import exc_text
+
+                attrs["error"] = exc_text(error)
+            sr.add({"trace_id": tid, "span_id": info["span"],
+                    "parent_id": info.get("parent"), "name": "wave",
+                    "start": start, "end": end, "attrs": attrs})
+            from .tracing import new_span_id
+
+            for name, k0, k1 in kids:
+                sr.add({"trace_id": tid, "span_id": new_span_id(),
+                        "parent_id": info["span"],
+                        "name": f"wave.{name}",
+                        "start": k0, "end": k1, "attrs": {}})
+        except Exception:  # pragma: no cover - tracing only
+            log.exception("wave span record")
 
     def _watchdog_run(self) -> None:
         while not self._closing.wait(self._watch_interval_s):

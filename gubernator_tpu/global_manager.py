@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Tuple
 
 from .config import BehaviorConfig
@@ -191,6 +192,27 @@ class GlobalManager:
 
     # ---- async loops ---------------------------------------------------
 
+    def _tick_context(self, name: str):
+        """Traced context for one async tick (ISSUE 12): the tick gets
+        its OWN trace (there is no caller request on this thread), a
+        root span named after the aggregate, and hop spans from the
+        lanes it sends on — so an owner-side UpdatePeerGlobals /
+        GetPeerRateLimits handler stitches back to the flush that
+        caused it.  No-op (null context) without a span recorder."""
+        rec = getattr(self.instance, "span_recorder", None)
+        if rec is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        from .tracing import request_context, span
+
+        @contextmanager
+        def _cm():
+            with request_context(None, recorder=rec), span(name):
+                yield
+
+        return _cm()
+
     def _run_async_hits(self) -> None:
         """Flush aggregated hits to each key's owner.
         reference: global.go › runAsyncHits.
@@ -203,6 +225,10 @@ class GlobalManager:
         (pipelined flushes, retry, circuit fail-fast), aggregated per
         peer per window.  Non-default pickers / no codec keep the
         legacy object flush."""
+        with self._tick_context("global.hits_flush"):
+            self._hits_tick()
+
+    def _hits_tick(self) -> None:
         if self._fault_tick("global_hits", "global hits flush"):
             return
         # Mesh reconcile backend (ISSUE 7, GUBER_GLOBAL_MODE=mesh):
@@ -353,6 +379,10 @@ class GlobalManager:
     def _run_broadcasts(self) -> None:
         """Owner side: push merged authoritative state to all peers.
         reference: global.go › runBroadcasts → UpdatePeerGlobals."""
+        with self._tick_context("global.broadcast"):
+            self._broadcast_tick()
+
+    def _broadcast_tick(self) -> None:
         if self._fault_tick("global_broadcast", "global broadcast"):
             return
         with self._mu:

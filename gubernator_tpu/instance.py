@@ -99,6 +99,23 @@ class V1Instance:
         #: stalls/timeouts, handover passes, GLOBAL broadcasts, errors —
         #: served as JSON at the daemon's GET /debug/events
         self.recorder = FlightRecorder()
+        # Trace plane (ISSUE 12, tracing.py): bounded ring of completed
+        # spans, armed per request by the daemon's handlers; head
+        # sampling at GUBER_TRACE_SAMPLE (default 0 — forced-sample
+        # outcomes still record), capacity GUBER_TRACE_SPANS.  Served
+        # at GET /debug/traces; spilled as JSONL on close.
+        from .tracing import SpanRecorder
+
+        try:
+            _sample = float(os.environ.get("GUBER_TRACE_SAMPLE") or 0.0)
+        except ValueError:
+            _sample = 0.0
+        try:
+            _tcap = int(os.environ.get("GUBER_TRACE_SPANS") or 2048)
+        except ValueError:
+            _tcap = 2048
+        self.span_recorder = SpanRecorder(capacity=max(_tcap, 1),
+                                          sample=_sample)
         # Fault injection (ISSUE 5, faults.py): per-instance named
         # faultpoints, armed from GUBER_FAULT / POST /debug/faults.
         # One attribute read per instrumented site while disarmed.
@@ -159,6 +176,8 @@ class V1Instance:
                                      recorder=self.recorder,
                                      analytics=analytics,
                                      faults=self.faults)
+        # waves emit fan-in spans + exact phase children (ISSUE 12)
+        self.dispatcher.span_recorder = self.span_recorder
         # Fused-engine wiring (ISSUE 8): the fused serving program
         # emits the heavy-hitter tap columns ON DEVICE — hand the
         # analytics sink + metrics registry to the engine BEFORE any
@@ -1273,9 +1292,15 @@ class V1Instance:
                 kh0 = int(raw[mask][0])
                 tenant = ana.tenant_hint(khash=kh0)
                 ana.tap_flag("degraded", rows, khash=kh0)
+            from .tracing import current_span_id, force_sample
+
+            force_sample("degraded")
             ev = {"peer": min(by_addr), "rows": rows, "rehomed": True}
             if tenant is not None:
                 ev["tenant"] = tenant
+            sid = current_span_id()
+            if sid is not None:
+                ev["span_id"] = sid
             self.recorder.record("degraded", **ev)
         return b"".join(items)
 
@@ -1966,9 +1991,15 @@ class V1Instance:
             kh0 = int(kh[idxs][0])
             tenant = ana.tenant_hint(khash=kh0)
             ana.tap_flag("degraded", m, khash=kh0)
+        from .tracing import current_span_id, force_sample
+
+        force_sample("degraded")
         ev = {"peer": peer_addr, "rows": m}
         if tenant is not None:
             ev["tenant"] = tenant
+        sid = current_span_id()
+        if sid is not None:
+            ev["span_id"] = sid
         self.recorder.record("degraded", **ev)
         return out
 
@@ -2240,10 +2271,16 @@ class V1Instance:
                         name=deg_failed[0][1].name)
                     ana.tap_flag("degraded", len(deg_failed),
                                  tenant=tenant)
+                from .tracing import current_span_id, force_sample
+
+                force_sample("degraded")
                 ev = {"peer": deg_failed[0][2],
                       "rows": len(deg_failed)}
                 if tenant is not None:
                     ev["tenant"] = tenant
+                sid = current_span_id()
+                if sid is not None:
+                    ev["span_id"] = sid
                 self.recorder.record("degraded", **ev)
             except Exception as e:  # noqa: BLE001 - degraded serve must
                 # never take the batch down; fall back to error rows
@@ -2926,8 +2963,15 @@ class V1Instance:
                     DEFAULT_BURN_THRESHOLD)
         p99_s = _flt(os.environ.get("GUBER_SLO_P99_MS", ""),
                      250.0) / 1000.0
+        def _breach_exemplar():
+            # a burning SLO links to one concrete sampled trace
+            # (ISSUE 12); None when nothing sampled recently
+            ex = self.span_recorder.exemplar()
+            return ex["trace_id"] if ex else None
+
         eng = SLOEngine(metrics=self.metrics, recorder=self.recorder,
-                        fast_s=fast, slow_s=slow, burn_threshold=burn)
+                        fast_s=fast, slow_s=slow, burn_threshold=burn,
+                        exemplar=_breach_exemplar)
         ana = self.dispatcher.analytics
 
         def decision_p99():
@@ -3091,5 +3135,12 @@ class V1Instance:
                 self.recorder.events(), slo_verdicts=verdicts)
             self.recorder.record("debug_dump_written", path=path,
                                  events=len(self.recorder))
+            spans = self.span_recorder.spans()
+            if spans:
+                # trace-plane sibling (ISSUE 12): sampled spans spill
+                # next to the event dump, trace_assemble.py-readable
+                from .telemetry import write_trace_dump
+
+                write_trace_dump(dirpath, iid, spans)
         except Exception as e:  # noqa: BLE001 - forensics is best-effort
             log.warning("debug dump failed: %s", exc_text(e))

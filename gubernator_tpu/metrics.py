@@ -378,3 +378,21 @@ class Metrics:
     def render(self) -> bytes:
         """Text exposition for the /metrics endpoint."""
         return generate_latest(self.registry)
+
+
+def observe_with_exemplar(hist, value: float, exemplar=None) -> None:
+    """Histogram observe with a best-effort exemplar attach (ISSUE 12).
+
+    ``exemplar`` is a small label dict (``{"trace_id": ...}`` from
+    SpanRecorder.exemplar()) linking the observation's bucket to one
+    concrete sampled trace — surfaced by the openmetrics exposition.
+    Any client that rejects the exemplar (older prometheus_client, a
+    >128-char label set) falls back to a plain observe: the exemplar
+    is a debugging link, never worth failing the serving path."""
+    if exemplar:
+        try:
+            hist.observe(value, exemplar)
+            return
+        except (TypeError, ValueError):
+            pass
+    hist.observe(value)

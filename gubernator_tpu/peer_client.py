@@ -599,13 +599,16 @@ class PeerClient:
         object-batching flusher below serves."""
         if self._closing.is_set():
             raise ErrClosing("peer client is closing")
-        from .tracing import current_traceparent
+        from .tracing import hop_traceparent
 
         if self._forward_lane is not None:
             from .wire import req_to_tlv
 
-            inner = self._forward_lane.enqueue(req_to_tlv(req), 1,
-                                               current_traceparent())
+            inner = self._forward_lane.enqueue(
+                req_to_tlv(req), 1,
+                hop_traceparent("peer.forward",
+                                attrs={"peer": self.info.grpc_address,
+                                       "items": 1}))
             outer: Future = Future()
 
             def _convert(f: Future) -> None:
@@ -619,7 +622,11 @@ class PeerClient:
             inner.add_done_callback(_convert)
             return outer
         fut = Future()
-        self._queue.put((req, fut, current_traceparent()))
+        self._queue.put((req, fut,
+                         hop_traceparent(
+                             "peer.forward",
+                             attrs={"peer": self.info.grpc_address,
+                                    "items": 1})))
         self._start_flusher()
         return fut
 
@@ -639,23 +646,41 @@ class PeerClient:
             raise RuntimeError("columnar peer lane needs the native "
                                "extension (run `make native`)")
         if traceparent is None:
-            from .tracing import current_traceparent
+            # mint + RECORD the hop (ISSUE 12): the header's span id
+            # becomes the owner-side request span's parent, stitching
+            # the two daemons' halves into one assembled trace
+            from .tracing import hop_traceparent
 
-            traceparent = current_traceparent()
+            traceparent = hop_traceparent(
+                "peer.forward",
+                attrs={"peer": self.info.grpc_address,
+                       "items": int(n_items)})
         return self._forward_lane.enqueue(data, n_items, traceparent)
 
-    def send_globals_raw(self, data: bytes, n_items: int) -> Future:
+    def send_globals_raw(self, data: bytes, n_items: int,
+                         traceparent: Optional[str] = None) -> Future:
         """Owner-broadcast twin of ``forward_raw``: ``data`` is
         ``n_items`` serialized UpdatePeerGlobalsReq.globals TLVs; the
         future resolves to the (empty) response bytes.  Serialized
         once, shared across every peer's lane — the per-peer pb2
-        re-serialization the typed stub forced is gone."""
+        re-serialization the typed stub forced is gone.  Like
+        forward_raw, a None ``traceparent`` captures (and records the
+        hop for) the calling thread's trace — the global manager's
+        tick wraps itself in a request context, so a broadcast is
+        traceable end-to-end (ISSUE 12)."""
         if self._closing.is_set():
             raise ErrClosing("peer client is closing")
         if self._globals_lane is None:
             raise RuntimeError("columnar peer lane needs the native "
                                "extension (run `make native`)")
-        return self._globals_lane.enqueue(data, n_items)
+        if traceparent is None:
+            from .tracing import hop_traceparent
+
+            traceparent = hop_traceparent(
+                "peer.forward",
+                attrs={"peer": self.info.grpc_address,
+                       "items": int(n_items), "lane": "globals"})
+        return self._globals_lane.enqueue(data, n_items, traceparent)
 
     def get_peer_rate_limits(self, reqs: Sequence[RateLimitRequest],
                              timeout_s: Optional[float] = None,

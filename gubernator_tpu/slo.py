@@ -147,9 +147,13 @@ class SLOEngine:
                  fast_s: float = DEFAULT_FAST_S,
                  slow_s: float = DEFAULT_SLOW_S,
                  burn_threshold: float = DEFAULT_BURN_THRESHOLD,
-                 clock=time.monotonic):
+                 clock=time.monotonic, exemplar=None):
         self.metrics = metrics
         self.recorder = recorder
+        #: optional zero-arg callable → recent sampled trace id
+        #: (ISSUE 12): breach events carry it as ``exemplar_trace`` so
+        #: a burning SLO links to one concrete trace
+        self.exemplar = exemplar
         self.fast_s = max(float(fast_s), 1e-3)
         self.slow_s = max(float(slow_s), self.fast_s)
         self.burn_threshold = float(burn_threshold)
@@ -260,6 +264,14 @@ class SLOEngine:
                 if tenant is not None:
                     ev["tenant"] = tenant
                 if kind == "slo_breach":
+                    if self.exemplar is not None:
+                        try:
+                            tid = self.exemplar()
+                        except Exception:  # pragma: no cover - link only
+                            tid = None
+                        if tid:
+                            # the breach→trace link (ISSUE 12)
+                            ev["exemplar_trace"] = tid
                     rec.record("slo_breach", **ev)
                 else:
                     rec.record("slo_recovered", **ev)

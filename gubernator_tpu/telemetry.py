@@ -89,19 +89,23 @@ class FlightRecorder:
     def events(self, limit: Optional[int] = None,
                kind: Optional[str] = None,
                since_seq: Optional[int] = None,
-               tenant: Optional[str] = None) -> List[dict]:
+               tenant: Optional[str] = None,
+               trace: Optional[str] = None) -> List[dict]:
         """Chronological snapshot (oldest first).  ``kind`` keeps only
         events of that kind, ``since_seq`` only events with
-        ``seq > since_seq``, and ``tenant`` only events carrying that
-        ``tenant`` field (all server-side, so isolating one tenant's
-        incident doesn't download the whole ring); ``limit`` then
-        keeps the newest N."""
+        ``seq > since_seq``, ``tenant`` only events carrying that
+        ``tenant`` field, and ``trace`` only events stamped with that
+        trace id (all server-side, so isolating one tenant's — or one
+        request's — incident doesn't download the whole ring);
+        ``limit`` then keeps the newest N."""
         with self._mu:
             out = list(self._ring)
         if kind:
             out = [e for e in out if e.get("kind") == kind]
         if tenant:
             out = [e for e in out if e.get("tenant") == tenant]
+        if trace:
+            out = [e for e in out if e.get("trace") == trace]
         if since_seq is not None:
             out = [e for e in out if e.get("seq", 0) > since_seq]
         if limit is not None and limit >= 0:
@@ -139,4 +143,28 @@ def write_debug_dump(dirpath: str, instance_id: str,
         f.write(json.dumps(header) + "\n")
         for ev in events:
             f.write(json.dumps(ev) + "\n")
+    return path
+
+
+def write_trace_dump(dirpath: str, instance_id: str,
+                     spans: List[dict], clock=time.time) -> str:
+    """Trace-plane sibling of ``write_debug_dump`` (ISSUE 12): the
+    SpanRecorder ring spilled as JSONL on drain — header line, then
+    one completed span per line — so sampled traces survive the
+    process.  ``tools/trace_assemble.py`` accepts these files
+    directly.  Same best-effort contract as the event dump."""
+    import json
+    import os
+
+    os.makedirs(dirpath, exist_ok=True)
+    t_ms = int(clock() * 1000)
+    safe = "".join(c if c.isalnum() or c in "-._" else "_"
+                   for c in str(instance_id)) or "instance"
+    path = os.path.join(dirpath, f"guber_traces_{safe}_{t_ms}.jsonl")
+    header = {"kind": "trace_header", "t_ms": t_ms,
+              "instance": str(instance_id), "spans": len(spans)}
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(header) + "\n")
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
     return path

@@ -119,6 +119,45 @@ def test_tiered_section_child_writes_row(tmp_path):
     assert "cold_store_native" in r and "tier_vs_uncapped" in r
 
 
+def test_tracing_ab_block_schema():
+    """The 6_service_path ``tracing_ab`` block (ISSUE 12): pin the A/B
+    schema — the armed-unsampled (<1%) and 1%-sampled (<3%) budget
+    verdicts — by running the helper directly on a small instance (the
+    full svc section is a device-backend child; the block's contract
+    is what the driver greps)."""
+    sys.path.insert(0, REPO)
+    import bench
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.oracle import OracleEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    inst = V1Instance(Config(cache_size=1 << 10, sweep_interval_ms=0),
+                      engine=OracleEngine())
+    try:
+        reqs = [RateLimitRequest(name="ab", unique_key=f"k{i}", hits=1,
+                                 limit=1000, duration=60_000)
+                for i in range(4)]
+        row = bench._tracing_ab(
+            inst, lambda r: inst.get_rate_limits(
+                reqs, now_ms=1_791_000_000_000 + r),
+            pairs=2, reps=4)
+        assert "error" not in row, row
+        for k in ("armed_overhead_pct", "overhead_ok",
+                  "sampled_overhead_pct", "sampled_ok",
+                  "off_calls_per_s", "pairs", "reps"):
+            assert k in row, (k, row)
+        assert isinstance(row["overhead_ok"], bool)
+        assert isinstance(row["sampled_ok"], bool)
+        assert row["off_calls_per_s"] > 0
+        assert row["pairs"] == 2 and row["reps"] == 4
+        # the A/B restores the recorder wiring it toggled
+        assert inst.dispatcher.span_recorder is inst.span_recorder
+        assert inst.span_recorder.sample == 0.0
+    finally:
+        inst.close()
+
+
 def test_section_registry_covers_baseline_rows():
     """Every BASELINE row key the orchestrator may need to error-fill
     is declared by exactly one section."""

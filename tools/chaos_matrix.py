@@ -486,9 +486,13 @@ def _slo_staleness_cell() -> dict:
 def _slo_error_ratio_cell() -> dict:
     """peer_send:error → every forwarded row degrades (or errors) →
     ``error_ratio`` burns past threshold and breaches; clearing the
-    fault and serving clean traffic must emit ``slo_recovered``."""
+    fault and serving clean traffic must emit ``slo_recovered``.
+    The driven requests run under a trace context, so the degraded
+    outcomes force-sample and the breach event must carry an
+    ``exemplar_trace`` (ISSUE 12: page → waterfall in one hop)."""
     from gubernator_tpu import cluster as cluster_mod
     from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.tracing import request_context
 
     spec = "peer_send:error"
     cell = {"cell": "slo_error_ratio", "slo": "error_ratio",
@@ -513,7 +517,8 @@ def _slo_error_ratio_cell() -> dict:
         ana = i0.dispatcher.analytics
 
         def drive(key):
-            i0.get_rate_limits_wire(_one(key), now_ms=NOW0)
+            with request_context(None, recorder=i0.span_recorder):
+                i0.get_rate_limits_wire(_one(key), now_ms=NOW0)
             if ana is not None:
                 ana.flush(timeout=2.0)  # land the RED taps
             i0.slo.tick()
@@ -532,12 +537,118 @@ def _slo_error_ratio_cell() -> dict:
             drive(local)  # clean rows dilute + age out the window
             recovered = _slo_events(i0, "slo_recovered", "error_ratio")
             time.sleep(0.1)
+        exemplar = any(
+            e.get("kind") == "slo_breach"
+            and e.get("slo") == "error_ratio"
+            and e.get("exemplar_trace")
+            for e in i0.recorder.events())
     finally:
         c.stop()
     cell.update({"breached": breached, "recovered": recovered,
+                 "exemplar": exemplar,
                  "elapsed_ms": round((time.perf_counter() - t0) * 1000,
                                      1),
-                 "ok": breached and recovered})
+                 "ok": breached and recovered and exemplar})
+    return cell
+
+
+def _trace_plane_cell() -> dict:
+    """peer_send:error → the forwarded request serves degraded, its
+    trace force-samples, and the CALLER-side slice still assembles
+    end-to-end (request span → ``peer.forward`` hop → local degraded
+    wave); after clearing the fault, a healthy forwarded request
+    stitches ACROSS daemons — the owner's handler + wave spans hang
+    under the caller's request span (ISSUE 12 acceptance shape)."""
+    from gubernator_tpu import cluster as cluster_mod
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.tracing import (assemble, current_trace_id,
+                                        request_context, span)
+
+    spec = "peer_send:error"
+    cell = {"cell": "trace_plane", "spec": spec}
+    t0 = time.perf_counter()
+    c = cluster_mod.start(2, behaviors=BehaviorConfig(
+        batch_timeout_ms=300, batch_wait_ms=50,
+        peer_retry_limit=1, peer_retry_backoff_ms=5,
+        peer_circuit_threshold=2, peer_circuit_cooldown_ms=200))
+    try:
+        i0, i1 = c.instance_at(0), c.instance_at(1)
+        remote = None
+        for i in range(200):
+            k = f"tk{i}"
+            if c.owner_daemon_of("chaos_" + k) is c.daemon_at(1):
+                remote = k
+                break
+        assert remote
+        r0, r1 = i0.span_recorder, i1.span_recorder
+        old_sample = (r0.sample, r1.sample)
+        r0.sample = r1.sample = 1.0
+
+        def names(node, acc):
+            acc.add(node["name"])
+            for ch in node.get("children", []):
+                names(ch, acc)
+            return acc
+
+        def drive():
+            with request_context(None, recorder=r0):
+                with span("grpc.GetRateLimits"):
+                    tid = current_trace_id()
+                    data = i0.get_rate_limits_wire(_one(remote),
+                                                   now_ms=NOW0)
+            return tid, _classify_rows(data)
+
+        def assembled(tid, spans, want):
+            traces = assemble(spans, trace_id=tid)
+            if len(traces) != 1 or len(traces[0]["roots"]) != 1:
+                return False  # still waiting on late wave spans
+            root = traces[0]["roots"][0]
+            return (root["name"] == "grpc.GetRateLimits"
+                    and want <= names(root, set()))
+
+        degraded_assembled = stitched = False
+        try:
+            i0.faults.arm(spec, seed=7)
+            deadline = time.monotonic() + 15.0
+            while (time.monotonic() < deadline
+                   and not degraded_assembled):
+                tid, outcome = drive()
+                if outcome != "served_degraded":
+                    continue
+                # the degraded wave lands from the dispatcher thread;
+                # poll until the caller slice holds the whole chain
+                sub = time.monotonic() + 2.0
+                while (time.monotonic() < sub
+                       and not degraded_assembled):
+                    degraded_assembled = assembled(
+                        tid, r0.spans(),
+                        {"peer.forward", "wave"})
+                    if not degraded_assembled:
+                        time.sleep(0.05)
+            i0.faults.clear()
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and not stitched:
+                time.sleep(0.25)  # let the peer circuit half-open
+                tid, outcome = drive()
+                if outcome != "served":
+                    continue
+                sub = time.monotonic() + 2.0
+                while time.monotonic() < sub and not stitched:
+                    stitched = assembled(
+                        tid, r0.spans() + r1.spans(),
+                        {"peer.forward", "grpc.GetPeerRateLimits",
+                         "wave"})
+                    if not stitched:
+                        time.sleep(0.05)
+        finally:
+            r0.sample, r1.sample = old_sample
+    finally:
+        c.stop()
+    cell.update({"degraded_assembled": degraded_assembled,
+                 "stitched": stitched,
+                 "elapsed_ms": round((time.perf_counter() - t0) * 1000,
+                                     1),
+                 "ok": degraded_assembled and stitched})
     return cell
 
 
@@ -546,7 +657,8 @@ def run_slo_cells(verbose=False) -> list:
     os.environ.update(_SLO_ENV)
     cells = []
     try:
-        for fn in (_slo_staleness_cell, _slo_error_ratio_cell):
+        for fn in (_slo_staleness_cell, _slo_error_ratio_cell,
+                   _trace_plane_cell):
             cell = fn()
             cells.append(cell)
             if verbose:

@@ -23,7 +23,11 @@ Asserts, against a fresh ``Metrics()`` registry:
 7. OBSERVABILITY.md's "SLO catalog & burn windows" table matches
    slo.SLO_CATALOG both ways — the declarative SLO registry is an
    operator contract, so an SLO that exists but isn't documented (or
-   a documented one that was removed) fails tier-1.
+   a documented one that was removed) fails tier-1;
+8. OBSERVABILITY.md's "Span catalog" table matches
+   tracing.SPAN_CATALOG both ways — same contract for the trace
+   plane: a span an operator meets in a waterfall must be in the doc,
+   and a doc row must name a span the code can actually emit.
 
 Exit 0 when clean; prints each violation and exits 1 otherwise.
 """
@@ -151,6 +155,26 @@ def slo_catalog_doc_problems() -> list:
     return problems
 
 
+def span_catalog_doc_problems() -> list:
+    """OBSERVABILITY.md's span-catalog table ↔ tracing.SPAN_CATALOG."""
+    from gubernator_tpu.tracing import SPAN_CATALOG
+
+    with open(DOC, encoding="utf-8") as f:
+        doc = f.read()
+    documented = _table_cell_names(doc, "### Span catalog",
+                                   r"`([A-Za-z][A-Za-z0-9_.]*)`")
+    problems = []
+    for name in sorted(set(SPAN_CATALOG) - documented):
+        problems.append(
+            f"span {name!r} is in tracing.SPAN_CATALOG but missing "
+            f"from OBSERVABILITY.md's span catalog table")
+    for name in sorted(documented - set(SPAN_CATALOG)):
+        problems.append(
+            f"OBSERVABILITY.md's span catalog table documents span "
+            f"{name!r} but tracing.SPAN_CATALOG has no such span")
+    return problems
+
+
 def env_registry_doc_problems() -> list:
     """CONCURRENCY.md's GUBER_* table ↔ config.ENV_REGISTRY, plus its
     lock-hierarchy table ↔ guberlint's LOCK_ORDER."""
@@ -228,6 +252,7 @@ def main() -> int:
     problems += faultpoint_doc_problems()
     problems += env_registry_doc_problems()
     problems += slo_catalog_doc_problems()
+    problems += span_catalog_doc_problems()
 
     if problems:
         for p in problems:

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Cluster-wide trace assembly: stitch N daemons' span slices into
+per-trace trees and render text waterfalls.
+
+Each daemon's ``/debug/traces`` (OBSERVABILITY.md "Distributed
+tracing") serves only its OWN slice of a distributed trace — the
+caller's request span and ``peer.forward`` hop live on the caller,
+the owner-side handler/wave spans on the owner.  Head sampling is
+decided from the trace id itself, so every daemon keeps the same
+traces and the slices always join.  This tool takes any mix of live
+endpoints (``--url``, repeatable) and on-disk spill files
+(``guber_traces_*.jsonl`` from ``GUBER_DEBUG_DUMP_DIR``, positional),
+merges the spans (duplicate span ids dedup), and prints one waterfall
+per assembled trace — the cross-daemon parent/child chain
+(request → hop → owner request → wave → phases) reads as one tree.
+
+Usage:
+    python tools/trace_assemble.py --url http://d0:1050 --url http://d1:1050
+    python tools/trace_assemble.py /var/dumps/guber_traces_*.jsonl
+    python tools/trace_assemble.py --url http://d0:1050 --trace-id <32hex>
+
+Exit status: 0 when at least one trace assembled (or --allow-empty),
+1 on fetch/parse failure or when nothing assembled.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gubernator_tpu.tracing import assemble, render_waterfall  # noqa: E402
+
+
+def _fetch_spans(url: str, trace_id: str, timeout: float) -> list:
+    if "/debug/traces" not in url:
+        url = url.rstrip("/") + "/debug/traces"
+    if trace_id:
+        url += ("&" if "?" in url else "?") + f"trace_id={trace_id}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = json.loads(r.read().decode("utf-8"))
+    return body.get("spans", [])
+
+
+def _read_spans(path: str) -> list:
+    """One span per JSONL line; ``trace_header`` metadata lines (and
+    any event-dump lines that snuck in via a glob) are skipped."""
+    spans = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(obj, dict) or "span_id" not in obj:
+                continue
+            spans.append(obj)
+    return spans
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="stitch daemons' /debug/traces slices (and/or "
+                    "guber_traces_*.jsonl spills) into waterfalls")
+    ap.add_argument("files", nargs="*",
+                    help="trace spill JSONL files (trace_header lines "
+                         "are skipped)")
+    ap.add_argument("--url", action="append", dest="urls", default=[],
+                    help="daemon HTTP base url; repeatable")
+    ap.add_argument("--trace-id", default="",
+                    help="assemble only this trace")
+    ap.add_argument("--width", type=int, default=40,
+                    help="waterfall bar width in characters")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the assembled trees as JSON instead")
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="exit 0 even when nothing assembled")
+    args = ap.parse_args(argv)
+    if not args.files and not args.urls:
+        ap.error("need at least one FILE or --url")
+
+    spans = []
+    for url in args.urls:
+        try:
+            spans.extend(_fetch_spans(url, args.trace_id, args.timeout))
+        except Exception as e:  # noqa: BLE001
+            print(f"trace_assemble: fetch failed ({url}): {e!r}",
+                  file=sys.stderr)
+            return 1
+    for path in args.files:
+        try:
+            spans.extend(_read_spans(path))
+        except OSError as e:
+            print(f"trace_assemble: read failed ({path}): {e!r}",
+                  file=sys.stderr)
+            return 1
+
+    traces = assemble(spans, trace_id=args.trace_id or None)
+    if args.json:
+        print(json.dumps(traces))
+    else:
+        for trace in traces:
+            print(render_waterfall(trace, width=args.width))
+            print()
+    if not traces:
+        print("trace_assemble: no traces assembled "
+              f"({len(spans)} spans read)", file=sys.stderr)
+        return 0 if args.allow_empty else 1
+    if not args.json:
+        print(f"trace_assemble: {len(traces)} trace(s) from "
+              f"{len(spans)} span(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,8 +2,9 @@
 
 PY ?= python
 
-.PHONY: test proto bench bench-pallas bench-tiered chaos tpu-session \
-        b-sweep daemon cluster lint native tsan asan racer check clean
+.PHONY: test proto bench bench-pallas bench-tiered bench-diff chaos \
+        tpu-session b-sweep daemon cluster lint native tsan asan racer \
+        check clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -66,6 +67,14 @@ bench-pallas:
 # A/B'd byte-for-byte against an uncapped oracle (ISSUE 10)
 bench-tiered:
 	GUBER_BENCH_SECTION=tiered $(PY) bench.py
+
+# perf-regression gate (ISSUE 13): diff the newest BENCH_r*.json
+# against the previous round with per-metric tolerance; rows the run
+# flagged environment-dominated (context/skipped_*/error) are skipped,
+# truncated artifacts are declared incomparable (exit 0), regressions
+# beyond tolerance exit 1
+bench-diff:
+	$(PY) tools/bench_compare.py
 
 # one-shot on-chip validation battery (run when a TPU is reachable)
 tpu-session:

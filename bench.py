@@ -892,6 +892,91 @@ def _tracing_ab(inst, call, pairs=5, reps=30) -> dict:
         rec.sample = old_sample
 
 
+def _memledger_ab(inst, call, pairs=5, reps=30) -> dict:
+    """ISSUE 13 acceptance: the device-memory ledger must stay off the
+    hot path — enrollment is registration-only and probes run on the
+    SLO tick / scrape threads, so steady-state serving overhead must
+    pin < 1%.
+
+    Interleaved timing pairs of the same call in two states: *off*
+    (ledger suspended — snapshots answer empty, nothing else changes)
+    and *on* (the shipping default; one out-of-band pressure_sample
+    between blocks keeps the plane exercised the way the 1 Hz SLO tick
+    does without charging tick work to the serving thread).  Same
+    alternating-order median-of-ratios discipline as ``_tracing_ab``."""
+    led = getattr(inst, "memledger", None)
+    if led is None:
+        return {"error": "memory ledger disabled (GUBER_MEM_LEDGER=0)"}
+
+    def rate():
+        t0 = time.perf_counter()
+        for r in range(reps):
+            call(r)
+        return reps / (time.perf_counter() - t0)
+
+    def _measure(which):
+        if which == "on":
+            led.resume()
+            led.pressure_sample()  # untimed: tick-thread work in prod
+        else:
+            led.suspend()
+        try:
+            return rate()
+        finally:
+            led.suspend()
+
+    try:
+        r_on, r_off = [], []
+        for pair in range(pairs + 1):
+            # alternate order per pair so monotonic host drift cancels
+            order = ("off", "on") if pair % 2 else ("on", "off")
+            got = {w: _measure(w) for w in order}
+            if pair == 0:
+                continue  # warmup pair, untimed
+            r_on.append(got["on"])
+            r_off.append(got["off"])
+        overhead = (float(np.median([o / n for o, n
+                                     in zip(r_off, r_on)])) - 1) * 100
+        row = {"overhead_pct": round(overhead, 2),
+               "overhead_ok": bool(overhead < 1.0),
+               "on_calls_per_s": round(float(np.median(r_on)), 1),
+               "off_calls_per_s": round(float(np.median(r_off)), 1),
+               "pairs": pairs, "reps": reps}
+        if not row["overhead_ok"]:
+            row["warning"] = ("memory ledger measured above its <1% "
+                              "budget on this run; single-host noise "
+                              "— re-run before acting on it")
+        return row
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+    finally:
+        led.resume()
+
+
+def _hbm_block(inst):
+    """Standardized ledger sub-block for the engine rows (6/11/12/13,
+    ISSUE 13): bytes + occupancy per consumer from ONE snapshot, so
+    rows compare like-for-like instead of each growing ad-hoc
+    occupancy fields."""
+    led = getattr(inst, "memledger", None)
+    if led is None:
+        return None
+    try:
+        snap = led.snapshot()
+        out = {"device_bytes": snap["device_bytes"],
+               "host_bytes": snap["host_bytes"],
+               "pressure": round(snap["pressure"], 4)}
+        for name, rec in snap["consumers"].items():
+            if "error" in rec:
+                continue
+            out[name] = {"bytes": rec["bytes"],
+                         "capacity_rows": rec["capacity_rows"],
+                         "occupied_rows": rec["occupied_rows"]}
+        return out
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+
+
 def _serialize_reqs(reqs_lists):
     """[[RateLimitRequest]] → serialized GetRateLimitsReq bytes."""
     from gubernator_tpu.proto import gubernator_pb2 as pb
@@ -1228,6 +1313,15 @@ def _sec_svc():
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["tracing_ab"] = {
                 "error": (str(e) or repr(e))[:200]}
+        # ISSUE 13 acceptance: device-memory ledger overhead A/B on
+        # the same wire-lane call (steady-state <1%)
+        try:
+            out["6_service_path"]["memledger_ab"] = _memledger_ab(
+                inst, lambda r: inst.get_rate_limits_wire(
+                    datas[r % 4], now_ms=NOW0 + 900 + r))
+        except Exception as e:  # noqa: BLE001
+            out["6_service_path"]["memledger_ab"] = {
+                "error": (str(e) or repr(e))[:200]}
         _section_checkpoint(out)
         # peer-forwarding path: what the owner-side apply of a
         # forwarded batch takes, via its wire lane (since ISSUE 3 the
@@ -1274,6 +1368,8 @@ def _sec_svc():
             # ISSUE 4: which keys were hot + where the ms went, straight
             # in the BENCH row (top-16 of the ledger + the phase ledger)
             out["6_service_path"]["analytics"] = _analytics_rows(inst)
+            # ISSUE 13: the standardized per-consumer memory block
+            out["6_service_path"]["hbm"] = _hbm_block(inst)
     finally:
         inst.close()
     return out
@@ -1790,7 +1886,7 @@ def _sec_pallas():
                     "engine_cls": type(inst.engine).__name__,
                     "fused_waves": getattr(inst.engine,
                                            "fused_wave_count", 0),
-                    "occupancy": int(inst.engine.occupancy()),
+                    "hbm": _hbm_block(inst),
                     "telemetry": _telemetry_rows(inst)}
         finally:
             inst.close()
@@ -1810,7 +1906,9 @@ def _sec_pallas():
         "fused_waves": fused["fused_waves"],
         "svc_p50_ms": round(float(np.percentile(fused["lat"], 50)), 3),
         "svc_p99_ms": round(float(np.percentile(fused["lat"], 99)), 3),
-        "occupancy": fused["occupancy"],
+        # ISSUE 13: the ad-hoc occupancy field became the standardized
+        # per-consumer memory block (comparable across rows 6/11/12/13)
+        "hbm": fused["hbm"],
         "telemetry": fused["telemetry"],
         # PhaseLedger evidence: the classic engine's waves carry a pack
         # segment; fused waves don't — `device` absorbed it, and the
@@ -1903,6 +2001,8 @@ def _sec_mesh():
         ana = mi.analytics
         if ana is not None:
             row["cost_model"] = ana.costmodel_snapshot()
+        # ISSUE 13: mesh-GLOBAL replica + accumulators in the ledger
+        row["hbm"] = _hbm_block(mi)
     finally:
         mi.close()
     gi = V1Instance(Config(cache_size=1 << 14, sweep_interval_ms=0,
@@ -1999,6 +2099,8 @@ def _sec_tiered():
             "demotions": st["demotions"],
             "migrations_aborted": st["migrations_aborted"],
             "cold_store_native": st["native"],
+            # ISSUE 13: hot table + host cold tier, one ledger block
+            "hbm": _hbm_block(ti),
         })
     finally:
         ti.close()

@@ -1194,6 +1194,29 @@ class KeyAnalytics:
                     "tracked_keys": len(self.sketch),
                     "queue_depth": self._q.qsize()}
 
+    def mem_stats(self) -> dict:
+        """Memory-ledger probe feed (ISSUE 13): the sketch's host
+        bytes are its five width-length columns, live at all times."""
+        with self._mu:
+            sk = self.sketch
+            nbytes = int(sk._cnt.nbytes + sk._err.nbytes
+                         + sk._over.nbytes + sk._last.nbytes
+                         + sk._kh.nbytes)
+            return {"bytes": nbytes, "width": sk.width,
+                    "used": len(sk),
+                    "total_weight": int(sk.total_weight)}
+
+    def rank_distribution(self, limit: int = 4096) -> List[int]:
+        """Space-Saving rank distribution: tracked counts, descending —
+        the hot table's marginal-hit-density curve for the memory
+        ledger's advisor (memledger.py › advise).  Rank r's count is
+        the observed demand a cache of r+1 rows would capture at the
+        margin; the advisor extrapolates past ``limit``."""
+        with self._mu:
+            used = len(self.sketch)
+            cnt = np.sort(self.sketch._cnt[:used])[::-1]
+        return [int(v) for v in cnt[:max(int(limit), 1)]]
+
     def topkeys_snapshot(self, limit: Optional[int] = None) -> dict:
         """The ``GET /debug/topkeys`` document (owner resolution is the
         daemon's job — it knows the ring)."""

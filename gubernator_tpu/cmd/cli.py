@@ -99,6 +99,18 @@ def _debug_main(argv) -> int:
     sl.add_argument("--timeout", type=float, default=10.0)
     sl.add_argument("--json", action="store_true",
                     help="print the raw JSON document")
+    mm = sub.add_parser("memory",
+                        help="dump the daemon's device-memory ledger "
+                             "(/debug/memory)")
+    mm.add_argument("--url", default="http://localhost:1050",
+                    help="daemon HTTP base url (or a full "
+                         "/debug/memory url)")
+    mm.add_argument("--advise", action="store_true",
+                    help="include the water-filling split "
+                         "recommendation (?advise=1)")
+    mm.add_argument("--timeout", type=float, default=10.0)
+    mm.add_argument("--json", action="store_true",
+                    help="print the raw JSON document")
     fl = sub.add_parser("faults",
                         help="inspect or arm the daemon's fault-"
                              "injection points (/debug/faults)")
@@ -121,6 +133,8 @@ def _debug_main(argv) -> int:
         return _debug_tenants(args)
     if args.what == "slo":
         return _debug_slo(args)
+    if args.what == "memory":
+        return _debug_memory(args)
     if args.what == "faults":
         return _debug_faults(args)
     if args.what == "traces":
@@ -313,6 +327,46 @@ def _debug_slo(args) -> int:
         if r.get("value") is not None:
             line += (f" value={r['value']} target={r['target']}")
         print(line)
+    return 0
+
+
+def _debug_memory(args) -> int:
+    """``debug memory``: the device-memory ledger round trip."""
+    url = args.url
+    if "/debug/memory" not in url:
+        url = url.rstrip("/") + "/debug/memory"
+    if args.advise:
+        url += ("&" if "?" in url else "?") + "advise=1"
+    try:
+        body = _fetch_json(url, args.timeout)
+    except Exception as e:  # noqa: BLE001
+        print(f"fetch failed: {e!r}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body))
+        return 0
+    print(f"device_bytes={body.get('device_bytes')} "
+          f"host_bytes={body.get('host_bytes')} "
+          f"pressure={body.get('pressure'):.4f} "
+          f"target={body.get('pressure_target')}")
+    for name, rec in sorted(body.get("consumers", {}).items()):
+        if "error" in rec:
+            print(f"  {name:<14} ERROR {rec['error']}")
+            continue
+        side = "host" if rec.get("host") else "hbm"
+        line = (f"  {name:<14} {side:<4} bytes={rec['bytes']:<12} "
+                f"rows={rec['occupied_rows']}/{rec['capacity_rows']}")
+        if rec.get("advisable"):
+            line += " advisable"
+        print(line)
+    adv = body.get("advise")
+    if adv:
+        print(f"advised split over {adv['total_rows']} rows "
+              f"(floor {adv['floor_rows']}):")
+        for name in sorted(adv.get("advised", {})):
+            print(f"  {name:<14} {adv['current'].get(name, 0):>8} "
+                  f"-> {adv['advised'][name]:>8} "
+                  f"(pow2 {adv['advised_pow2'][name]})")
     return 0
 
 

@@ -84,6 +84,9 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_KSPLIT": "device step: probe K-split override (core/step.py)",
     "GUBER_LOG_LEVEL": "root log level",
     "GUBER_MEMBERLIST_KNOWN_HOSTS": "memberlist discovery: seed hosts",
+    "GUBER_MEM_ADVISE_FLOOR": "memory ledger: per-consumer minimum rows in the advised split (default 64)",
+    "GUBER_MEM_LEDGER": "0 disables the device-memory ledger plane (default 1)",
+    "GUBER_MEM_PRESSURE": "hbm_pressure SLO target: byte-weighted occupancy fraction (default 0.85)",
     "GUBER_MESH_FALLBACK_AFTER": "consecutive mesh-GLOBAL fold failures before the tier stands down to the gRPC path",
     "GUBER_MESH_GLOBAL_CAP": "mesh-GLOBAL replica table capacity (keys; power of two)",
     "GUBER_MULTI_REGION_BATCH_LIMIT": "cross-region replication batch limit",

@@ -198,6 +198,19 @@ class WaveBufferPool:
                     "leaks": self.leaks, "outstanding": self.outstanding,
                     "pooled": sum(len(v) for v in self._free.values())}
 
+    def mem_stats(self) -> dict:
+        """Memory-ledger probe feed (ISSUE 13): host bytes the idle
+        rings hold right now — summed from the live arrays, so an
+        odd-width burst or a shrunk ring stays exact."""
+        with self._mu:
+            pooled = nbytes = 0
+            for ring in self._free.values():
+                for a64, a32 in ring:
+                    pooled += 1
+                    nbytes += int(a64.nbytes) + int(a32.nbytes)
+            return {"pooled": pooled, "pooled_bytes": nbytes,
+                    "hits": self.hits}
+
 
 def bucket_size(n: int) -> int:
     for b in BATCH_BUCKETS:

@@ -310,6 +310,11 @@ class Daemon:
                         # churn (≤ K removes + sets) costs the scraper,
                         # never the serving loop or analytics worker
                         ana.republish()
+                    led = getattr(daemon.instance, "memledger", None)
+                    if led is not None:
+                        # same scrape-time discipline for the ledger
+                        # gauges: probes run on the scraper's dime
+                        led.republish(daemon.instance.metrics)
                     self._send(200, daemon.instance.metrics.render(),
                                "text/plain; version=0.0.4")
                 elif path in ("/v1/HealthCheck", "/healthz"):
@@ -346,6 +351,18 @@ class Daemon:
                         # objectives — the --fail-on-burn readiness feed
                         if daemon.instance.slo is not None:
                             body["slo"] = daemon.instance.slo.health()
+                        # device-memory ledger totals (ISSUE 13): the
+                        # pressure fraction a capacity probe wants
+                        led = getattr(daemon.instance, "memledger",
+                                      None)
+                        if led is not None:
+                            snap = led.snapshot()
+                            body["memory"] = {
+                                "device_bytes": snap["device_bytes"],
+                                "host_bytes": snap["host_bytes"],
+                                "pressure": snap["pressure"],
+                                "pressure_target":
+                                    snap["pressure_target"]}
                     self._send(code, json.dumps(body).encode())
                 elif path == "/debug/events":
                     # flight recorder ring (telemetry.py), newest-last;
@@ -452,6 +469,23 @@ class Daemon:
                         return
                     self._send(200, json.dumps(
                         daemon.instance.slo.snapshot()).encode())
+                elif path == "/debug/memory":
+                    # device-memory ledger (ISSUE 13, memledger.py):
+                    # per-consumer bytes / capacity / occupancy /
+                    # demand vector; ?advise=1 adds the water-filling
+                    # split recommendation (advisory — nothing
+                    # repartitions live)
+                    led = getattr(daemon.instance, "memledger", None)
+                    if led is None:
+                        self._send(404, json.dumps(
+                            {"error": "memory ledger disabled "
+                                      "(GUBER_MEM_LEDGER=0)"}).encode())
+                        return
+                    body = led.snapshot()
+                    if q.get("advise", ["0"])[-1] not in ("", "0",
+                                                          "false"):
+                        body["advise"] = led.advise()
+                    self._send(200, json.dumps(body).encode())
                 elif path == "/debug/costmodel":
                     # fitted collective cost model (analytics.py ›
                     # CostModel): per-(phase, ndev) alpha/beta

@@ -124,6 +124,16 @@ class V1Instance:
         self.faults = FaultSet.from_env()
         self.faults.metrics = self.metrics
         self.faults.recorder = self.recorder
+        # Device-memory ledger (ISSUE 13, memledger.py): every device-
+        # resident allocation enrolls with a probe closure; serves the
+        # memledger gauges, GET /debug/memory (+?advise=1), and the
+        # hbm_pressure SLO.  GUBER_MEM_LEDGER=0 disables the plane.
+        self.memledger = None
+        self._memledger_live = 0  # last occupancy_nowait sample
+        if os.environ.get("GUBER_MEM_LEDGER", "1") != "0":
+            from .memledger import MemoryLedger
+
+            self.memledger = MemoryLedger(recorder=self.recorder)
         if engine is None:
             # lazy: an injected engine (tests, alternative backends)
             # must not drag the sharded/jax stack in
@@ -222,6 +232,9 @@ class V1Instance:
                 skip_victim=self._tier_victim_pinned, tap=tap,
                 rank_batch=(analytics.sketch_counts
                             if analytics is not None else None))
+        # every eagerly-built consumer enrolls now; the lazy tiers
+        # (hot set, mesh-GLOBAL) enroll inside their _ensure_* builders
+        self._enroll_memledger()
         self._peer_tls = peer_tls_creds
         # Datacenter-aware deployments route through a region picker
         # (region_picker.go); single-region uses the flat ring.
@@ -2444,6 +2457,9 @@ class V1Instance:
                 self._hot_sync_loop = IntervalLoop(
                     self.config.behaviors.global_sync_wait_ms,
                     self._hotset.sync, name="hotset-psum-sync")
+                if self.memledger is not None:
+                    self.memledger.enroll("hotset", self._probe_hotset,
+                                          advisable=True)
             return self._hotset
 
     # ---- mesh-resident GLOBAL (ISSUE 7, parallel/meshglobal.py) --------
@@ -2478,6 +2494,10 @@ class V1Instance:
                 self._meshglobal = MeshGlobalEngine(
                     self.engine.mesh, capacity=cap,
                     batch_per_chip=self.config.batch_rows)
+                if self.memledger is not None:
+                    self.memledger.enroll("mesh_global",
+                                          self._probe_meshglobal,
+                                          advisable=True)
                 # fused engines (ISSUE 8) fold the tier's home-replica
                 # decide + accumulator scatter into the serving wave's
                 # program — one launch per wave even in mesh mode.
@@ -3014,6 +3034,14 @@ class V1Instance:
                          SLO_CATALOG["error_ratio"]))
         eng.register(SLO("shed_ratio", "ratio", 0.999, shed_ratio,
                          SLO_CATALOG["shed_ratio"]))
+        led = self.memledger
+        if led is not None:
+            # the ledger's pressure sample IS the (value, target) pair;
+            # it also edge-triggers the memory_pressure event, so the
+            # early-warning fires on the same tick cadence as the SLO
+            eng.register(SLO("hbm_pressure", "threshold", 0.95,
+                             led.pressure_sample,
+                             SLO_CATALOG["hbm_pressure"]))
         if ana is not None:
             eng.register_group(
                 "tenant_error_ratio", 0.999,
@@ -3089,6 +3117,129 @@ class V1Instance:
         # engine's bucket rows) — layout-specific counting lives there
         return self.engine.occupancy()
 
+    # ---- device-memory ledger probes (ISSUE 13) --------------------
+    # Each probe re-reads the live attributes at snapshot time (state
+    # arrays rebind on grow/sweep/donated steps) and takes the owning
+    # lock itself — the ledger never holds its own lock across a probe.
+
+    def _enroll_memledger(self) -> None:
+        led = self.memledger
+        if led is None:
+            return
+        if getattr(self.engine, "state", None) is not None \
+                and hasattr(self.engine, "cap_local"):
+            led.enroll("hot_table", self._probe_hot_table,
+                       advisable=True)
+        if getattr(self.engine, "wave_pool", None) is not None:
+            led.enroll("wave_pool", self._probe_wave_pool, host=True)
+        if self.analytics is not None:
+            led.enroll("sketch", self._probe_sketch, host=True)
+        if self._tier is not None:
+            led.enroll("cold_store", self._probe_cold_store, host=True)
+
+    @staticmethod
+    def _leaves_nbytes(leaves) -> int:
+        return sum(int(getattr(a, "nbytes", 0)) for a in leaves)
+
+    def _probe_hot_table(self) -> dict:
+        import jax
+
+        eng = self.engine
+        # under _engine_mu: the donated step consumes and rebinds
+        # state mid-wave — an unlocked read can hold a deleted buffer
+        with self._engine_mu:
+            nbytes = self._leaves_nbytes(jax.tree.leaves(eng.state))
+            cap = int(getattr(eng, "cap_local", 0)) \
+                * int(getattr(eng, "n", 1))
+            live = int(getattr(eng, "live_rows", -1))
+            if live < 0:
+                # tick-cadence sampler: must not WAIT on the device
+                # gate while holding the engine lock (that convoys
+                # serving waves in multi-engine processes) — reuse the
+                # last sample when the gate is contended
+                fresh = eng.occupancy_nowait() \
+                    if hasattr(eng, "occupancy_nowait") else None
+                if fresh is None:
+                    live = self._memledger_live
+                else:
+                    live = self._memledger_live = int(fresh)
+        demand: dict = {}
+        ana = self.analytics
+        if ana is not None:
+            demand["ranks"] = ana.rank_distribution()
+        tier = self._tier
+        if tier is not None:
+            st = tier.stats()
+            demand["promote_rate"] = st.get("promotions", 0)
+            demand["demote_rate"] = st.get("demotions", 0)
+            demand["overflow"] = st.get("cold_served", 0)
+        return {"bytes": nbytes, "capacity_rows": cap,
+                "occupied_rows": max(live, 0), "demand": demand}
+
+    def _probe_wave_pool(self) -> dict:
+        pool = getattr(self.engine, "wave_pool", None)
+        if pool is None:
+            return {"bytes": 0}
+        st = pool.mem_stats()
+        return {"bytes": st["pooled_bytes"], "capacity_rows": 0,
+                "occupied_rows": st["pooled"],
+                "demand": {"rate": st["hits"]}}
+
+    def _probe_sketch(self) -> dict:
+        ana = self.analytics
+        if ana is None:
+            return {"bytes": 0}
+        st = ana.mem_stats()
+        return {"bytes": st["bytes"], "capacity_rows": st["width"],
+                "occupied_rows": st["used"],
+                "demand": {"rate": st["total_weight"]}}
+
+    def _probe_cold_store(self) -> dict:
+        tier = self._tier
+        if tier is None:
+            return {"bytes": 0}
+        st = tier.stats()
+        return {"bytes": tier.mem_bytes(), "capacity_rows": 0,
+                "occupied_rows": st["cold_keys"],
+                "demand": {"promote_rate": st["promotions"],
+                           "demote_rate": st["demotions"],
+                           "rate": st["cold_served"]}}
+
+    def _probe_hotset(self) -> dict:
+        import jax
+
+        hs = self._hotset
+        if hs is None:
+            return {"bytes": 0}
+        with hs._state_mu:
+            nbytes = self._leaves_nbytes(
+                jax.tree.leaves(hs.state) + [hs.base_rem, hs.base_t])
+        with hs._mu:
+            occ = len(hs.slots)
+        with self._hot_mu:
+            rate = float(sum(self._hot_counts.values()))
+        return {"bytes": nbytes, "capacity_rows": int(hs.capacity),
+                "occupied_rows": occ, "demand": {"hit_rate": rate}}
+
+    def _probe_meshglobal(self) -> dict:
+        import jax
+
+        mge = self._meshglobal
+        if mge is None:
+            return {"bytes": 0}
+        # state + BOTH accumulator buffers; never mge.stats() here —
+        # it drains collectives, a probe must stay read-only
+        with mge._state_mu:
+            nbytes = self._leaves_nbytes(
+                jax.tree.leaves(mge.state)
+                + jax.tree.leaves(mge._acc))
+            folded = float(mge.folded_hits + mge.injected_hits)
+        with mge._mu:
+            occ = len(mge._occupied)
+        return {"bytes": nbytes, "capacity_rows": int(mge.capacity),
+                "occupied_rows": occ,
+                "demand": {"fold_rate": folded}}
+
     def close(self) -> None:
         """Flush async managers, snapshot via Loader, drop peers.
         reference: V1Instance.Close (SURVEY.md §3.5)."""
@@ -3112,6 +3263,11 @@ class V1Instance:
             self.dispatcher.analytics.close()
         self._write_debug_dump()
         self._save_to_loader()
+        if self.memledger is not None:
+            # stand the ledger down leak-free: every enrolled consumer
+            # releases (tests assert consumers() drains to empty here)
+            for consumer in self.memledger.consumers():
+                self.memledger.release(consumer)
         for p in self.peers():
             p.shutdown()
 

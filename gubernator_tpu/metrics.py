@@ -365,6 +365,16 @@ class Metrics:
             "multiple; breach latches when fast AND slow exceed the "
             "threshold — see GET /debug/slo); tenant label empty for "
             "instance-level SLOs", ["slo", "tenant"], registry=r)
+        self.memledger_bytes = Gauge(
+            "gubernator_memledger_bytes",
+            "live bytes per memory-ledger consumer (host-side "
+            "consumers report host bytes — see GET /debug/memory)",
+            ["consumer"], registry=r)
+        self.memledger_rows = Gauge(
+            "gubernator_memledger_rows",
+            "memory-ledger rows per consumer: state=capacity is the "
+            "allocated row budget, state=occupied the live occupancy",
+            ["consumer", "state"], registry=r)
 
     @contextmanager
     def time_func(self, name: str):

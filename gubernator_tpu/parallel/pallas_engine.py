@@ -830,7 +830,17 @@ class PallasServingEngine(FusedServingMixin, ShardedEngine):
         return removed
 
     def occupancy(self) -> int:
-        return int(self._occ_sat_fn(self.state)[0])
+        with XLA_EXEC_MU:
+            return int(self._occ_sat_fn(self.state)[0])
+
+    def occupancy_nowait(self) -> int | None:
+        """See ShardedEngine.occupancy_nowait — bucket-layout flavor."""
+        if not XLA_EXEC_MU.acquire(blocking=False):
+            return None
+        try:
+            return int(self._occ_sat_fn(self.state)[0])
+        finally:
+            XLA_EXEC_MU.release()
 
     def bucket_saturation(self) -> tuple[int, int]:
         """(full_buckets, total_buckets) — the capacity-safety
@@ -841,13 +851,15 @@ class PallasServingEngine(FusedServingMixin, ShardedEngine):
         be 40% occupied yet have hot buckets saturated).  Exported as
         gubernator_pallas_bucket_saturation; VERDICT r4 item 6."""
         total = (self.n * self.cap_local) // ps.SLOTS
-        return int(self._occ_sat_fn(self.state)[1]), total
+        with XLA_EXEC_MU:
+            return int(self._occ_sat_fn(self.state)[1]), total
 
     def occupancy_and_saturation(self) -> tuple[int, int, int]:
         """(live_rows, full_buckets, total_buckets) in ONE device call
         — health_check refreshes both gauges under the engine lock, so
         it must not pay two round trips there."""
-        occ, full = self._occ_sat_fn(self.state)
+        with XLA_EXEC_MU:
+            occ, full = self._occ_sat_fn(self.state)
         return (int(occ), int(full),
                 (self.n * self.cap_local) // ps.SLOTS)
 

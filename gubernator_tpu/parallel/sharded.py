@@ -406,8 +406,8 @@ class ShardedEngine:
 
             with XLA_EXEC_MU:
                 self.state = sweep_expired(self.state, np.int64(now_ms))
-            if self.auto_grow_limit:
-                self.live_rows = int(occupancy(self.state))
+                if self.auto_grow_limit:
+                    self.live_rows = int(occupancy(self.state))
         self.sweep_count += 1
         # Proactive growth: open-addressing probe windows start
         # exhausting on unlucky keys well before the table is full
@@ -1014,7 +1014,27 @@ class ShardedEngine:
         """Live (non-empty) rows right now — health/metrics surface."""
         from ..core.table import occupancy
 
-        return int(occupancy(self.state))
+        # under XLA_EXEC_MU: an eager device reduction; health checks
+        # and the memory-ledger probes call this from their own threads
+        # while other in-process engines serve (see mesh.py)
+        with XLA_EXEC_MU:
+            return int(occupancy(self.state))
+
+    def occupancy_nowait(self) -> int | None:
+        """Non-blocking occupancy for tick-cadence samplers (the memory
+        ledger): None when the device gate is contended.  A sampler
+        holding the engine lock must never WAIT on XLA_EXEC_MU — in
+        multi-engine processes that convoys every serving wave behind
+        another engine's in-flight program; the caller reuses its last
+        sample instead."""
+        if not XLA_EXEC_MU.acquire(blocking=False):
+            return None
+        try:
+            from ..core.table import occupancy
+
+            return int(occupancy(self.state))
+        finally:
+            XLA_EXEC_MU.release()
 
     def probe_occupant_keys(self, kh: int) -> np.ndarray:
         """The resident key hashes in ``kh``'s probe window (up to

@@ -60,6 +60,9 @@ SLO_CATALOG: Dict[str, str] = {
                           "tenant's rows",
     "tenant_shed_ratio": "per-tenant shed rows / that tenant's "
                          "(attributed + shed) rows",
+    "hbm_pressure": "byte-weighted device occupancy fraction vs "
+                    "GUBER_MEM_PRESSURE (fires before table-full / "
+                    "cap-overflow starts demoting)",
 }
 
 DEFAULT_FAST_S = 60.0

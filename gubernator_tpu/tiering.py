@@ -327,6 +327,14 @@ class TierController:
         with self._mu:
             return len(self._store)
 
+    def mem_bytes(self) -> int:
+        """Host bytes the cold tier holds (memory-ledger probe, ISSUE
+        13): one 8-byte key plus the ROW_COLS int64 columns per row —
+        exact for the native store, the Python-dict store's estimate
+        uses the same row layout."""
+        with self._mu:
+            return len(self._store) * (len(ROW_COLS) + 1) * 8
+
     def stats(self) -> dict:
         with self._mu:
             return {"cold_keys": len(self._store),

@@ -158,6 +158,43 @@ def test_tracing_ab_block_schema():
         inst.close()
 
 
+def test_memledger_ab_block_schema():
+    """The 6_service_path ``memledger_ab`` block (ISSUE 13): pin the
+    A/B schema and its <1% steady-state budget verdict by running the
+    helper directly on a small instance, and that the A/B leaves the
+    ledger resumed (the toggle it flips must restore)."""
+    sys.path.insert(0, REPO)
+    import bench
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.oracle import OracleEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    inst = V1Instance(Config(cache_size=1 << 10, sweep_interval_ms=0),
+                      engine=OracleEngine())
+    try:
+        assert inst.memledger is not None
+        reqs = [RateLimitRequest(name="ab", unique_key=f"k{i}", hits=1,
+                                 limit=1000, duration=60_000)
+                for i in range(4)]
+        row = bench._memledger_ab(
+            inst, lambda r: inst.get_rate_limits(
+                reqs, now_ms=1_791_000_000_000 + r),
+            pairs=2, reps=4)
+        assert "error" not in row, row
+        for k in ("overhead_pct", "overhead_ok", "on_calls_per_s",
+                  "off_calls_per_s", "pairs", "reps"):
+            assert k in row, (k, row)
+        assert isinstance(row["overhead_ok"], bool)
+        assert row["on_calls_per_s"] > 0
+        assert row["off_calls_per_s"] > 0
+        assert row["pairs"] == 2 and row["reps"] == 4
+        # the A/B restores the ledger state it toggled
+        assert inst.memledger.enabled is True
+    finally:
+        inst.close()
+
+
 def test_section_registry_covers_baseline_rows():
     """Every BASELINE row key the orchestrator may need to error-fill
     is declared by exactly one section."""

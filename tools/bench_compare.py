@@ -1,0 +1,197 @@
+"""Diff the newest BENCH round against the previous one (ISSUE 13).
+
+The BENCH trajectory (BENCH_r01.json, BENCH_r02.json, ...) records what
+each PR's flagship run measured, but nothing ever compared two rounds —
+a silent throughput regression would ride a green PR.  `make bench-diff`
+runs this gate: per-row relative deltas with per-metric tolerance,
+skipping rows the run itself flagged as environment-dominated (a
+``context`` note, ``skipped_*`` fields, or an ``error`` row measures
+the host or the harness, not the code).
+
+Artifacts come in two shapes: a raw ``bench.py`` result document, or a
+driver wrapper ``{"n", "cmd", "rc", "tail", "parsed"}`` whose ``parsed``
+may be null and whose ``tail`` holds only the last few KB of stdout.
+When neither yields a result document the rounds are INCOMPARABLE —
+that's a printed diagnosis and exit 0, not a failure: the gate must
+never turn a truncated artifact into a fake regression.
+
+Exit codes: 0 = no regression (or incomparable), 1 = regression beyond
+tolerance, 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: relative-change tolerance by metric kind; single-host rounds are
+#: noisy, so throughput gets a wide band (BENCH_r* context notes pin
+#: run-to-run spread at ~±15% on the shared build host)
+THROUGHPUT_TOL = 0.30  # *_per_s: lower is worse
+LATENCY_TOL = 0.50     # *_ms: higher is worse
+
+#: keys that flag a row as environment-dominated (the run said so)
+_SKIP_KEYS = ("context", "error")
+
+
+def _extract_result(doc):
+    """A bench result document from either artifact shape, or None."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("extra"), dict):
+        return doc  # raw bench.py output
+    if isinstance(doc.get("parsed"), dict) \
+            and isinstance(doc["parsed"].get("extra"), dict):
+        return doc["parsed"]
+    # driver wrapper with parsed=null: scavenge the tail for the final
+    # result line (bench.py prints exactly one JSON document)
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and isinstance(
+                    cand.get("extra"), dict):
+                return cand
+    return None
+
+
+def _rows(result) -> dict:
+    cfgs = (result.get("extra") or {}).get("baseline_configs") or {}
+    return {k: v for k, v in cfgs.items() if isinstance(v, dict)}
+
+
+def _row_skip_reason(row: dict):
+    for k in _SKIP_KEYS:
+        if k in row:
+            return k
+    for k in row:
+        if k.startswith("skipped_"):
+            return k
+    return None
+
+
+def _numeric_metrics(row: dict) -> dict:
+    """Scalar comparable metrics of one row (one level deep only —
+    nested A/B blocks carry their own ok-verdicts, compared as bools)."""
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, bool) or isinstance(v, (int, float)):
+            out[k] = v
+        elif isinstance(v, dict):
+            for kk, vv in v.items():
+                if isinstance(vv, bool) and kk.endswith("_ok"):
+                    out[f"{k}.{kk}"] = vv
+    return out
+
+
+def _direction(key: str):
+    """+1 when higher is better, -1 when lower is better, None when
+    the metric carries no regression semantics (counts, capacities)."""
+    leaf = key.rsplit(".", 1)[-1]
+    if re.search(r"(_|^)per_s$", leaf) or leaf.endswith("_rate"):
+        return +1
+    if leaf.endswith("_ms"):
+        return -1
+    return None
+
+
+def compare(prev_rows: dict, new_rows: dict) -> dict:
+    regressions, skipped, compared = [], [], 0
+    for name in sorted(set(prev_rows) & set(new_rows)):
+        pr, nr = prev_rows[name], new_rows[name]
+        reason = _row_skip_reason(pr) or _row_skip_reason(nr)
+        if reason:
+            skipped.append({"row": name, "reason": reason})
+            continue
+        pm, nm = _numeric_metrics(pr), _numeric_metrics(nr)
+        for key in sorted(set(pm) & set(nm)):
+            old, new = pm[key], nm[key]
+            if isinstance(old, bool) or isinstance(new, bool):
+                compared += 1
+                if old is True and new is False:
+                    regressions.append(
+                        {"row": name, "metric": key,
+                         "old": old, "new": new,
+                         "why": "verdict flipped true -> false"})
+                continue
+            sign = _direction(key)
+            if sign is None or old == 0:
+                continue
+            compared += 1
+            rel = (new - old) / abs(old)
+            tol = THROUGHPUT_TOL if sign > 0 else LATENCY_TOL
+            if sign * rel < -tol:
+                regressions.append(
+                    {"row": name, "metric": key, "old": old,
+                     "new": new, "rel_change": round(rel, 4),
+                     "tolerance": tol})
+    return {"compared_metrics": compared, "regressions": regressions,
+            "skipped_rows": skipped,
+            "rows_in_both": sorted(set(prev_rows) & set(new_rows))}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff the newest BENCH_r*.json against the "
+                    "previous round")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--pattern", default="BENCH_r*.json")
+    ap.add_argument("--json", action="store_true",
+                    help="one-line JSON verdict")
+    args = ap.parse_args(argv)
+
+    paths = sorted(glob.glob(os.path.join(args.dir, args.pattern)))
+    if len(paths) < 2:
+        print(f"incomparable: need >= 2 rounds matching "
+              f"{args.pattern} in {args.dir}, found {len(paths)}")
+        return 0
+    prev_path, new_path = paths[-2], paths[-1]
+    docs = []
+    for p in (prev_path, new_path):
+        try:
+            with open(p) as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"incomparable: {p}: {e}")
+            return 0
+    prev, new = (_extract_result(d) for d in docs)
+    if prev is None or new is None:
+        bad = prev_path if prev is None else new_path
+        print(f"incomparable: {os.path.basename(bad)} holds no bench "
+              "result document (truncated driver tail, parsed=null) — "
+              "nothing to diff")
+        return 0
+    verdict = compare(_rows(prev), _rows(new))
+    verdict["prev"] = os.path.basename(prev_path)
+    verdict["new"] = os.path.basename(new_path)
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        print(f"{verdict['prev']} -> {verdict['new']}: "
+              f"{verdict['compared_metrics']} metrics across "
+              f"{len(verdict['rows_in_both'])} rows")
+        for s in verdict["skipped_rows"]:
+            print(f"  skip {s['row']} ({s['reason']}: "
+                  "environment-dominated)")
+        for r in verdict["regressions"]:
+            print(f"  REGRESSION {r['row']}.{r['metric']}: "
+                  f"{r['old']} -> {r['new']} "
+                  f"({r.get('rel_change', 'verdict')})")
+        if not verdict["regressions"]:
+            print("  no regressions beyond tolerance")
+    return 1 if verdict["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -552,6 +552,88 @@ def _slo_error_ratio_cell() -> dict:
     return cell
 
 
+def _memory_pressure_cell() -> dict:
+    """tier churn against a capped hot table → byte-weighted occupancy
+    climbs past GUBER_MEM_PRESSURE → ``hbm_pressure`` breaches while
+    the rows are live, with the breach carrying an ``exemplar_trace``
+    (the driven churn runs sampled, ISSUE 12 wiring); sweeping the
+    expired churn keys drains occupancy and the engine must emit the
+    matching ``slo_recovered`` (ISSUE 13)."""
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.tracing import request_context
+    from gubernator_tpu.types import RateLimitRequest
+
+    cell = {"cell": "memory_pressure", "slo": "hbm_pressure",
+            "spec": "tier_churn_vs_4k_cap"}
+    t0 = time.perf_counter()
+    # a target the churn phase clears decisively even where probe
+    # exhaustion tops the open-addressed table out below 100% load
+    prev = os.environ.get("GUBER_MEM_PRESSURE")
+    os.environ["GUBER_MEM_PRESSURE"] = "0.6"
+    try:
+        inst = V1Instance(Config(
+            cache_size=4096, cache_autogrow_max=4096,
+            tier_cold=True, tier_promote_threshold=2,
+            hot_set_capacity=0, sweep_interval_ms=0))
+    finally:
+        if prev is None:
+            os.environ.pop("GUBER_MEM_PRESSURE", None)
+        else:
+            os.environ["GUBER_MEM_PRESSURE"] = prev
+    try:
+        inst.span_recorder.sample = 1.0  # every churn batch commits a
+        # sampled trace, so the breach tick has an exemplar to link
+        now = NOW0
+        nkey = 0
+
+        def churn(n=500):
+            nonlocal now, nkey
+            reqs = [RateLimitRequest(
+                name="chaos", unique_key=f"mp{nkey + i}", hits=1,
+                limit=10 ** 6, duration=30_000)
+                for i in range(n)]
+            nkey += n
+            now += 1
+            with request_context(None, recorder=inst.span_recorder):
+                inst.get_rate_limits(reqs, now_ms=now)
+            inst.slo.tick()
+
+        churn(64)  # healthy baseline sample: occupancy well under target
+        deadline = time.monotonic() + 15.0
+        breached = False
+        while time.monotonic() < deadline and not breached:
+            churn()  # distinct 30s-lived keys: occupancy only climbs
+            breached = _slo_events(inst, "slo_breach", "hbm_pressure")
+        # relieve: everything driven above has expired; one sweep
+        # reclaims the rows and occupancy collapses to ~zero
+        now += 60_000
+        recovered = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and breached and not recovered:
+            with inst._engine_mu:
+                inst.engine.sweep(now)
+            inst.slo.tick()
+            recovered = _slo_events(inst, "slo_recovered",
+                                    "hbm_pressure")
+            time.sleep(0.1)  # let the bad ticks age out of the window
+        exemplar = any(
+            e.get("kind") == "slo_breach"
+            and e.get("slo") == "hbm_pressure"
+            and e.get("exemplar_trace")
+            for e in inst.recorder.events())
+        pressure, target = inst.memledger.pressure_sample()
+    finally:
+        inst.close()
+    cell.update({"breached": breached, "recovered": recovered,
+                 "exemplar": exemplar,
+                 "final_pressure": round(pressure, 4), "target": target,
+                 "elapsed_ms": round((time.perf_counter() - t0) * 1000,
+                                     1),
+                 "ok": breached and recovered and exemplar})
+    return cell
+
+
 def _trace_plane_cell() -> dict:
     """peer_send:error → the forwarded request serves degraded, its
     trace force-samples, and the CALLER-side slice still assembles
@@ -658,7 +740,7 @@ def run_slo_cells(verbose=False) -> list:
     cells = []
     try:
         for fn in (_slo_staleness_cell, _slo_error_ratio_cell,
-                   _trace_plane_cell):
+                   _memory_pressure_cell, _trace_plane_cell):
             cell = fn()
             cells.append(cell)
             if verbose:

@@ -9,10 +9,13 @@ PY ?= python
 test:
 	$(PY) -m pytest tests/ -q
 
-# concurrency-discipline static analysis (tools/guberlint/, see
+# whole-program correctness suite (tools/guberlint/, see
 # CONCURRENCY.md): guarded-by, lock order, GUBER_* env registry,
-# faultpoint catalog, thread inventory.  Zero violations at HEAD is a
-# tier-1 invariant (tests/test_lint_clean.py).
+# faultpoint catalog, thread inventory, clock-domain taint,
+# traced-code purity, retrace stability, operator-doc consistency.
+# Zero violations at HEAD is a tier-1 invariant and the full suite
+# must finish inside the pinned 30 s wall-clock budget — both
+# enforced by tests/test_lint_clean.py.
 lint:
 	$(PY) -m tools.guberlint
 
@@ -37,10 +40,12 @@ racer:
 	JAX_PLATFORMS=cpu $(PY) tools/racer.py --seed 1 --runs 2
 
 # CI-style gate: static analysis + sanitizer soaks + the concurrency
-# test subset (the full tier-1 battery stays `make test`)
+# test subset + the compile-ledger gate (steady-state zero recompiles
+# on the service path); the full tier-1 battery stays `make test`
 check: lint tsan asan
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_guberlint.py \
-	    tests/test_lint_clean.py tests/test_created_at.py \
+	    tests/test_lint_clean.py tests/test_compileledger.py \
+	    tests/test_created_at.py \
 	    tests/test_cold_conservation.py tests/test_native.py \
 	    tests/test_interval.py tests/test_dispatcher.py -q
 

@@ -485,16 +485,28 @@ def main():
 
 
 def _lint_clean():
-    """True when `python -m tools.guberlint` would report zero
-    violations right now; False on violations; None when the linter
-    itself could not run (never fails the bench)."""
+    """Provenance block: the guberlint verdict for the tree this row
+    was measured on (clean flag + pass/violation counts) plus the
+    process's compile-ledger verdict — the runtime retrace
+    cross-check.  None when the linter itself could not run (never
+    fails the bench)."""
     try:
-        from tools.guberlint import run_passes
+        from tools.guberlint import PASS_NAMES, run_passes
 
-        return not run_passes()
+        violations = run_passes()
+        block = {"clean": not violations, "passes": len(PASS_NAMES),
+                 "violations": len(violations)}
     except Exception as e:  # noqa: BLE001 - provenance only
         log(f"lint_clean probe failed: {(str(e) or repr(e))[:120]}")
         return None
+    try:
+        from gubernator_tpu.compileledger import LEDGER
+
+        block["compile_ledger"] = LEDGER.verdict()
+    except Exception as e:  # noqa: BLE001 - provenance only
+        log(f"compile_ledger probe failed: {(str(e) or repr(e))[:120]}")
+        block["compile_ledger"] = None
+    return block
 
 
 PARTIAL_PATH = os.environ.get("GUBER_BENCH_PARTIAL",
@@ -1227,6 +1239,21 @@ def _sec_svc():
                 float(np.percentile(lat, 99)), 3)
         except Exception as e:  # noqa: BLE001
             out["6_service_path"]["wire_lane_error"] = (str(e) or repr(e))[:200]
+        # ISSUE 14 acceptance: the compile ledger proves the warmed
+        # service path is retrace-stable — mark steady AFTER the loops
+        # above compiled everything, serve another measured burst, and
+        # record the verdict (steady_recompiles must be empty; the
+        # static twin is guberlint's retrace pass)
+        try:
+            led = inst.compile_ledger
+            led.mark_steady()
+            for r in range(10):
+                inst.get_rate_limits_wire(datas[r % 4],
+                                          now_ms=NOW0 + 300 + r)
+            out["6_service_path"]["compile_ledger"] = led.verdict()
+        except Exception as e:  # noqa: BLE001
+            out["6_service_path"]["compile_ledger"] = {
+                "error": (str(e) or repr(e))[:200]}
         _section_checkpoint(out)
         # concurrent front door: 16 caller threads through the full
         # wire lane — the dispatcher coalesces them into shared waves

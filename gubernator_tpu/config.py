@@ -55,6 +55,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_CAP_AB_ANY_BACKEND": "tools/cap_ab: allow non-TPU backends",
     "GUBER_CLIENT_ADDRESS": "HTTP client-facing listen address",
     "GUBER_COALESCE_US": "dispatcher coalescing window in µs (0 disables the wait)",
+    "GUBER_COMPILE_LEDGER": "0 disables the runtime jit-compile ledger (compileledger.py): per-fn XLA compile counts, gubernator_jit_compiles, the steady-state recompile verdict",
     "GUBER_CREATED_AT_FWD": "0 disables caller-clock forwarding (created_at stamp) — pre-fix cold-key-loss demo ONLY",
     "GUBER_DATA_CENTER": "data-center name for DC-aware picking",
     "GUBER_DEBUG_DUMP_DIR": "crash forensics: close() dumps the event ring + final SLO verdicts here as JSONL",

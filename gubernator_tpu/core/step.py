@@ -239,7 +239,7 @@ def _insert(tkey: jax.Array, slots: jax.Array, key: jax.Array,
                                                   unique_indices=True)
         claim = jnp.where(winner, cand,
                           cap + jnp.arange(B, dtype=cand.dtype))
-        if _CHECK_SCATTER_INVARIANTS:  # trace-time test hook
+        if _CHECK_SCATTER_INVARIANTS:  # traced-ok: test-only scatter-invariant hook, off in production
             jax.debug.callback(_record_unique, "insert_tkey", claim)
         tkey = _scatter_rows(tkey, claim, key, sorted_idx=False)
         row = jnp.where(winner, cand, row)
@@ -660,7 +660,7 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
         # as the table writeback below
         idxj = jnp.where(m, seg_start + j,
                          B + jnp.arange(B, dtype=i32)).astype(i32)
-        if _CHECK_SCATTER_INVARIANTS:  # trace-time test hook
+        if _CHECK_SCATTER_INVARIANTS:  # traced-ok: test-only scatter-invariant hook, off in production
             jax.debug.callback(_record_unique, "body_idxj", idxj)
         reqj = _Req(*[x.at[idxj].get(mode="fill", fill_value=0) for x in sf])
         item2, outj = _apply_position(item, reqj)
@@ -691,7 +691,7 @@ def decide_batch_impl(state: TableState, batch: RequestBatch, now_ms: jax.Array
     # indices_are_sorted too (verified on real wrow vectors by
     # tests/test_scatter_invariants.py)
     wrow = jnp.where(exists, seg_row, cap + jnp.arange(B, dtype=i32))
-    if _CHECK_SCATTER_INVARIANTS:  # trace-time test hook, no cost when off
+    if _CHECK_SCATTER_INVARIANTS:  # traced-ok: test-only scatter-invariant hook, no cost when off
         jax.debug.callback(_record_wrow, wrow)
     meta_new = (item_final.alg & 1) | ((item_final.status & 1) << 1)
 

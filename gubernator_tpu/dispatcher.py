@@ -915,7 +915,7 @@ class Dispatcher:
             import time as _time
 
             total = sum(phases.values()) if phases else dur
-            start = _time.time() - total
+            start = _time.time() - total  # clock-ok: telemetry wall clock (span layout)
             # lay the children end-to-end FIRST and take the wave's
             # end from the same cumulative walk — bitwise-exact
             # partition (start + sum(...) differs in the last float

@@ -352,6 +352,7 @@ class GlobalManager:
                 chunk = tlvs[i:i + limit]
                 ent = entries[i:i + limit]
                 try:
+                    # clock-ok: GLOBAL aggregate hit deltas — accumulated counts, not fresh requests; the owner's authoritative bucket is the time base by design
                     futs.append((addr, peer.forward_raw(
                         b"".join(chunk), len(chunk)), ent))
                 except Exception as e:  # noqa: BLE001 - ErrCircuitOpen/

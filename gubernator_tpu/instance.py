@@ -68,7 +68,7 @@ def _created_at_fwd_enabled() -> bool:
     return os.environ.get("GUBER_CREATED_AT_FWD", "1") != "0"
 
 def clock_ms() -> int:
-    return time.time_ns() // 1_000_000
+    return time.time_ns() // 1_000_000  # clock-ok: the clock source itself
 
 
 def _forward_fail_reason(e: Optional[BaseException]) -> str:
@@ -134,6 +134,17 @@ class V1Instance:
             from .memledger import MemoryLedger
 
             self.memledger = MemoryLedger(recorder=self.recorder)
+        # Compile ledger (ISSUE 14, compileledger.py): per-fn XLA
+        # compile counts + the steady-state recompile verdict — the
+        # runtime twin of guberlint's retrace pass.  Process-wide
+        # singleton (compiles are process-wide events); each instance
+        # mirrors counts into its own registry.
+        from .compileledger import LEDGER as _compile_ledger
+        from .compileledger import install_if_enabled
+
+        if install_if_enabled():
+            _compile_ledger.attach_metrics(self.metrics)
+        self.compile_ledger = _compile_ledger
         if engine is None:
             # lazy: an injected engine (tests, alternative backends)
             # must not drag the sharded/jax stack in
@@ -300,7 +311,7 @@ class V1Instance:
         self._handover_gen = 0  # guarded-by: self._handover_gen_mu
         self._handover_gen_mu = threading.Lock()
         self._closed = False
-        self._last_sweep = clock_ms()
+        self._last_sweep = clock_ms()  # clock-ok: sweep cadence bookkeeping, never a bucket stamp
         self.store = config.store
         self.loader = config.loader
         if self.loader is not None:
@@ -821,7 +832,7 @@ class V1Instance:
         # any engine work (raises ResourceExhausted → RESOURCE_EXHAUSTED)
         self.dispatcher.admit(
             len(reqs), tenant_cb=lambda: self._tenant_of_reqs(reqs))
-        now = clock_ms() if now_ms is None else now_ms
+        now = clock_ms() if now_ms is None else now_ms  # clock-domain: caller
         self.metrics.getratelimit_counter.labels(calltype="api").inc(len(reqs))
         self.metrics.concurrent_checks.inc()
         try:
@@ -895,7 +906,7 @@ class V1Instance:
                 raise ValueError(
                     f"Requests.RateLimits list too large; max size is "
                     f"{MAX_BATCH_SIZE}")
-            now = clock_ms() if now_ms is None else now_ms
+            now = clock_ms() if now_ms is None else now_ms  # clock-domain: caller
             # all gating happens before metrics or state are touched:
             # a None runner falls through to the object path untouched
             if clustered:
@@ -987,7 +998,7 @@ class V1Instance:
         prepack = getattr(self.engine, "prepack_wire", None)
         if prepack is None:
             return None
-        now = clock_ms() if now_ms is None else now_ms
+        now = clock_ms() if now_ms is None else now_ms  # clock-domain: caller
         t_ing = time.perf_counter()
         pre = prepack(data, now)
         if pre is None:
@@ -1037,7 +1048,7 @@ class V1Instance:
         prepack = getattr(self.engine, "prepack_wire", None)
         if prepack is None:
             return None
-        now = clock_ms() if now_ms is None else now_ms
+        now = clock_ms() if now_ms is None else now_ms  # clock-domain: caller
         t_ing = time.perf_counter()
         pre = prepack(data, now)
         if pre is None:
@@ -1214,7 +1225,7 @@ class V1Instance:
             raise ValueError(
                 "'PeerRequest.rate_limits' list too large; max size is "
                 f"{self.config.behaviors.batch_limit}")
-        now = clock_ms() if now_ms is None else now_ms
+        now = clock_ms() if now_ms is None else now_ms  # clock-domain: owner
         self.metrics.getratelimit_counter.labels(calltype="peer").inc(
             parsed["n"])
         self.metrics.wire_lane_counter.labels(lane="peer_wire").inc(
@@ -1231,8 +1242,10 @@ class V1Instance:
         if parsed["behavior_or"] & int(Behavior.MULTI_REGION):
             mr = (parsed["behavior"]
                   & int(Behavior.MULTI_REGION)) != 0
+            # clock-ok: first-hop-wins — stamp_ms only fills rows missing a created_at TLV; stamped rows keep the caller's time base
             self._queue_mr_raw(parsed, data, mr, stamp_ms=now)
         if gate_rehome:
+            # clock-ok: first-hop-wins fallback, same as _queue_mr_raw above
             out = self._peer_degraded_rewrite(parsed, data, out,
                                               stamp_ms=now)
         return out
@@ -1374,6 +1387,7 @@ class V1Instance:
         changed for the next broadcast tick (queue_update_raw), as
         get_peer_rate_limits does per request on the object path."""
         gm = self._ensure_global_manager()
+        # clock-ok: broadcast marking only — queue_update_raw records WHICH keys changed, applies no hits, needs no created_at stamp
         for k, tlv, _a, _i in self._raw_queue_groups(parsed, data, mask):
             gm.queue_update_raw(k, tlv)
 
@@ -2789,7 +2803,7 @@ class V1Instance:
             raise ValueError(
                 "'PeerRequest.rate_limits' list too large; max size is "
                 f"{self.config.behaviors.batch_limit}")
-        now = clock_ms() if now_ms is None else now_ms
+        now = clock_ms() if now_ms is None else now_ms  # clock-domain: owner
         self.metrics.getratelimit_counter.labels(calltype="peer").inc(len(reqs))
         reqs = list(reqs)
         self._read_through(reqs)

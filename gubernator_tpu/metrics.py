@@ -110,6 +110,12 @@ class Metrics:
             "mesh-GLOBAL hits scatter-added by the fused serving "
             "program (the injected side of the mesh conservation "
             "ledger for fused waves)", registry=r)
+        self.jit_compiles = Counter(
+            "gubernator_jit_compiles",
+            "XLA compilations by jitted function (compile ledger, "
+            "ISSUE 14); any growth after warmup is a retrace bug — "
+            "a call site is recompiling the serving program",
+            ["fn"], registry=r)
         # Dispatcher wave telemetry (ISSUE 1): the wave/queue/compile
         # layer is the hot path and was previously unobservable — a
         # 250-305 s cold compile surfaced only as an empty TimeoutError

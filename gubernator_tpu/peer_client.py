@@ -712,6 +712,7 @@ class PeerClient:
                if _wire_native is not None else None)
         if cnt is None:
             raise ValueError("unparseable request TLV bytes")
+        # clock-ok: pass-through — callers stamp created_at into the raw TLVs (stamp_req_tlvs / _req_stamped) before handing bytes here
         return self.forward_raw(data, cnt)
 
     def update_peer_globals(self, updates: Sequence[peers_pb.UpdatePeerGlobal]

@@ -305,7 +305,7 @@ def hop_traceparent(name: str, attrs: Optional[dict] = None
     sid = secrets.token_hex(8)
     st = getattr(_tls, "span", None)
     if st is not None and st.trace_id == tid:
-        now = time.time()
+        now = time.time()  # clock-ok: telemetry wall clock (span timestamps)
         st.recorder.add({
             "trace_id": tid, "span_id": sid,
             "parent_id": st.stack[-1] if st.stack else st.parent,
@@ -382,7 +382,7 @@ def span(name: str, metrics=None, attrs: Optional[dict] = None
     if st is not None:
         sid = secrets.token_hex(8)
         parent = st.stack[-1] if st.stack else st.parent
-        wall0 = time.time()
+        wall0 = time.time()  # clock-ok: telemetry wall clock (span start)
         st.stack.append(sid)
     try:
         if _tracer is not None:  # pragma: no cover

@@ -239,3 +239,26 @@ def test_flagship_defaults_are_the_round5_shape():
     # bench must NOT export a probe override anymore: the serving
     # default (core/step.py PROBES == 8) is the flagship window
     assert got["probes_env"] == "", got
+
+
+def test_lint_clean_and_compile_ledger_provenance_schema():
+    """The ``extra.lint_clean`` provenance block (ISSUE 14): pin its
+    schema — clean flag, pass/violation counts, and the compile-ledger
+    verdict whose shape row 6_service_path's ``compile_ledger`` block
+    shares (both come from CompileLedger.verdict())."""
+    sys.path.insert(0, REPO)
+    import bench
+    from tools.guberlint import PASS_NAMES
+
+    block = bench._lint_clean()
+    assert block is not None, "lint probe failed entirely"
+    assert set(block) == {"clean", "passes", "violations",
+                          "compile_ledger"}
+    assert block["clean"] is True and block["violations"] == 0
+    assert block["passes"] == len(PASS_NAMES) == 9
+    cl = block["compile_ledger"]
+    assert cl is not None, "compile ledger probe failed"
+    assert set(cl) == {"enabled", "installed", "marked_steady",
+                       "total_compiles", "steady_recompiles", "steady"}
+    assert isinstance(cl["steady_recompiles"], dict)
+    assert isinstance(cl["steady"], bool)

@@ -1,0 +1,154 @@
+"""Compile ledger (ISSUE 14, gubernator_tpu/compileledger.py): the
+runtime half of the retrace-stability contract.
+
+The static ``retrace`` guberlint pass proves jit call SITES cannot
+drift; this file proves the live process agrees, both ways:
+
+- the WARMED service path performs zero XLA compiles (the tier-1
+  steady-state gate `make check` runs);
+- a deliberate dtype-drift escape — the exact bug class the static
+  pass hunts — makes the detector fire (a detector that cannot fire
+  certifies nothing).
+
+Also pinned: the logging-hook lifecycle (install is idempotent,
+uninstall restores the jax logger's level/propagate/handlers exactly),
+metric mirroring into ``gubernator_jit_compiles``, and the
+GUBER_COMPILE_LEDGER=0 off switch.
+"""
+import logging
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gubernator_tpu.compileledger import (_JAX_COMPILE_LOGGER,
+                                          CompileLedger, LEDGER, enabled,
+                                          install_if_enabled)
+
+NOW = 1_793_000_000_000
+
+
+@pytest.fixture
+def ledger():
+    """A fresh ledger installed on the real jax compile logger,
+    uninstalled afterwards no matter what."""
+    led = CompileLedger()
+    assert led.install()
+    try:
+        yield led
+    finally:
+        led.uninstall()
+
+
+def test_jax_compile_logger_exists_and_records(ledger):
+    """Pins the hook point: jax must emit per-compile records on
+    _JAX_COMPILE_LOGGER — a jax upgrade that moves the logger must
+    fail HERE, loudly, not silently record nothing forever."""
+
+    def _cl_probe(x):
+        return x + 1
+
+    f = jax.jit(_cl_probe)
+    f(jnp.ones(3, jnp.int32))
+    counts = ledger.counts()
+    assert "_cl_probe" in counts and counts["_cl_probe"] == 1
+
+
+def test_steady_state_zero_then_drift_fires(ledger):
+    def _cl_drift(x):
+        return x * 2
+
+    f = jax.jit(_cl_drift)
+    f(jnp.ones(4, jnp.int32))  # warmup compile
+    ledger.mark_steady()
+    f(jnp.ones(4, jnp.int32))  # cache hit: no compile
+    assert ledger.steady_compiles() == {}
+    assert ledger.verdict()["steady"] is True
+    # the deliberate escape: dtype drift at the call site recompiles
+    f(jnp.ones(4, jnp.float32))
+    steady = ledger.steady_compiles()
+    assert steady.get("_cl_drift") == 1, steady
+    v = ledger.verdict()
+    assert v["steady"] is False
+    assert v["steady_recompiles"]["_cl_drift"] == 1
+    assert v["marked_steady"] is True and v["installed"] is True
+
+
+def test_uninstall_restores_logger_state():
+    lg = logging.getLogger(_JAX_COMPILE_LOGGER)
+    level0, prop0, handlers0 = lg.level, lg.propagate, list(lg.handlers)
+    led = CompileLedger()
+    led.install()
+    assert lg.level == logging.DEBUG and lg.propagate is False
+    assert len(lg.handlers) == len(handlers0) + 1
+    led.uninstall()
+    assert lg.level == level0 and lg.propagate is prop0
+    assert lg.handlers == handlers0
+    led.uninstall()  # idempotent
+
+
+def test_metrics_mirroring(ledger):
+    from gubernator_tpu.metrics import Metrics
+
+    m = Metrics()
+    ledger.attach_metrics(m)
+    ledger.attach_metrics(m)  # idempotent: no double bump
+
+    def _cl_metric(x):
+        return x - 1
+
+    jax.jit(_cl_metric)(jnp.ones(2, jnp.int32))
+    sample = m.registry.get_sample_value(
+        "gubernator_jit_compiles_total", {"fn": "_cl_metric"})
+    assert sample == 1.0
+
+
+def test_env_gate(monkeypatch):
+    monkeypatch.setenv("GUBER_COMPILE_LEDGER", "0")
+    assert enabled() is False
+    assert install_if_enabled() is False
+    monkeypatch.delenv("GUBER_COMPILE_LEDGER")
+    assert enabled() is True
+
+
+def test_service_path_steady_state_zero_recompiles():
+    """The tier-1 gate: a warmed V1Instance serving the wire lane must
+    not compile ANYTHING per wave — the runtime proof behind bench row
+    6_service_path's compile_ledger block."""
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance, _wire_native
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.types import RateLimitRequest
+    from gubernator_tpu.wire import req_to_pb
+
+    if _wire_native is None:  # pragma: no cover
+        pytest.skip("native extension not built")
+    inst = V1Instance(Config(cache_size=1 << 12, sweep_interval_ms=0),
+                      mesh=make_mesh(n=1))
+    try:
+        # instance construction installs the process singleton
+        assert inst.compile_ledger is LEDGER
+        assert LEDGER.installed
+        datas = []
+        for b in range(3):
+            m = pb.GetRateLimitsReq()
+            m.requests.extend(
+                req_to_pb(RateLimitRequest(
+                    name="ledger", unique_key=f"k{b}_{i}", hits=1,
+                    limit=100, duration=60_000))
+                for i in range(32))
+            datas.append(m.SerializeToString())
+        for r in range(4):  # warmup: compiles happen here
+            inst.get_rate_limits_wire(datas[r % 3], now_ms=NOW + r)
+        LEDGER.mark_steady()
+        for r in range(12):  # steady state: same shapes, same dtypes
+            inst.get_rate_limits_wire(datas[r % 3], now_ms=NOW + 10 + r)
+        steady = LEDGER.steady_compiles()
+        assert steady == {}, (
+            f"steady-state service path recompiled: {steady} — a jit "
+            f"call site is retrace-unstable (see guberlint's retrace "
+            f"pass and CONCURRENCY.md › Retrace stability)")
+        assert LEDGER.verdict()["steady"] is True
+    finally:
+        inst.close()

@@ -270,3 +270,473 @@ class TestCliAndApi:
     def test_unknown_pass_is_loud(self):
         with pytest.raises(ValueError, match="unknown guberlint pass"):
             run_passes(passes=["nope"])
+
+class TestClockDomainPass:
+    """The PR-6 bug class as lint fixtures: every diagnostic has a
+    seeded mutant that trips it and a blessed/fixed twin that doesn't."""
+
+    def test_untagged_clock_read_flagged(self, tmp_path):
+        src = """
+            def front_door(now_ms=None):
+                now = clock_ms()
+                return now
+        """
+        vs = lint_fixture(tmp_path, src, ["clockdomain"])
+        assert len(vs) == 1
+        assert vs[0].pass_id == "clockdomain"
+        assert "untagged clock read" in vs[0].message
+        assert "clock-domain" in vs[0].message
+
+    def test_time_module_reads_also_require_tag(self, tmp_path):
+        src = """
+            import time
+
+            def probe():
+                return time.time_ns()
+        """
+        vs = lint_fixture(tmp_path, src, ["clockdomain"])
+        assert len(vs) == 1 and "time.time_ns" in vs[0].message
+
+    def test_domain_tag_clears_it(self, tmp_path):
+        src = """
+            def front_door(now_ms=None):
+                now = clock_ms()  # clock-domain: caller
+                return now
+        """
+        assert lint_fixture(tmp_path, src, ["clockdomain"]) == []
+
+    def test_clock_ok_clears_it(self, tmp_path):
+        src = """
+            import time
+
+            def probe():
+                return time.time()  # clock-ok: telemetry wall clock
+        """
+        assert lint_fixture(tmp_path, src, ["clockdomain"]) == []
+
+    def test_def_line_tag_blesses_function(self, tmp_path):
+        src = """
+            def sweep_tick(self):  # clock-ok: sweep cadence, not a bucket stamp
+                t = clock_ms()
+                return t
+        """
+        assert lint_fixture(tmp_path, src, ["clockdomain"]) == []
+
+    def test_owner_taint_into_stamp_kwarg_flagged(self, tmp_path):
+        src = """
+            class Inst:
+                def apply_peer(self, parsed, data, mr):
+                    now = clock_ms()  # clock-domain: owner
+                    self._queue_mr_raw(parsed, data, mr, stamp_ms=now)
+        """
+        vs = lint_fixture(tmp_path, src, ["clockdomain"])
+        assert len(vs) == 1
+        assert "owner-domain clock value flows" in vs[0].message
+        assert "_queue_mr_raw" in vs[0].message
+
+    def test_owner_taint_propagates_through_assignments(self, tmp_path):
+        src = """
+            class Inst:
+                def apply_peer(self, req):
+                    now = clock_ms()  # clock-domain: owner
+                    stamp = now + 5
+                    return tlv_with_created(req, stamp)
+        """
+        vs = lint_fixture(tmp_path, src, ["clockdomain"])
+        assert len(vs) == 1
+        assert "tlv_with_created" in vs[0].message
+
+    def test_caller_domain_stamp_is_clean(self, tmp_path):
+        src = """
+            class Inst:
+                def front_door(self, parsed, data, mr):
+                    now = clock_ms()  # clock-domain: caller
+                    self._queue_mr_raw(parsed, data, mr, stamp_ms=now)
+        """
+        assert lint_fixture(tmp_path, src, ["clockdomain"]) == []
+
+    def test_first_hop_wins_bless_clears_owner_stamp(self, tmp_path):
+        src = """
+            class Inst:
+                def apply_peer(self, parsed, data, mr):
+                    now = clock_ms()  # clock-domain: owner
+                    # clock-ok: first-hop-wins — only fills rows missing created_at
+                    self._queue_mr_raw(parsed, data, mr, stamp_ms=now)
+        """
+        assert lint_fixture(tmp_path, src, ["clockdomain"]) == []
+
+    def test_reverted_stamp_site_trips_queue_hits_rule(self, tmp_path):
+        # the exact PR-6 regression: drop _req_stamped from the
+        # deferred-apply enqueue and the pass must fire
+        bad = """
+            class GM:
+                def record_hit(self, req, now):
+                    self.queue_hits(req, 1)
+        """
+        vs = lint_fixture(tmp_path, bad, ["clockdomain"])
+        assert len(vs) == 1
+        assert "queue_hits" in vs[0].message
+        assert "created_at stamp" in vs[0].message
+        good = """
+            class GM:
+                def record_hit(self, req, now):
+                    self.queue_hits(self._req_stamped(req, now), 1)
+        """
+        assert lint_fixture(tmp_path, good, ["clockdomain"]) == []
+
+    def test_raw_queue_without_stamp_ms_flagged(self, tmp_path):
+        bad = """
+            class Inst:
+                def fan_out(self, parsed, data, mask):
+                    for k in self._raw_queue_groups(parsed, data, mask):
+                        pass
+        """
+        vs = lint_fixture(tmp_path, bad, ["clockdomain"])
+        assert len(vs) == 1 and "stamp_ms=" in vs[0].message
+        good = bad.replace("(parsed, data, mask)",
+                           "(parsed, data, mask, stamp_ms=now)")
+        assert lint_fixture(tmp_path, good, ["clockdomain"]) == []
+
+    def test_forward_without_stamp_evidence_flagged(self, tmp_path):
+        bad = """
+            class Lane:
+                def flush(self, peer, data):
+                    return peer.forward_raw(data, 4)
+        """
+        vs = lint_fixture(tmp_path, bad, ["clockdomain"])
+        assert len(vs) == 1
+        assert "forward_raw" in vs[0].message
+        assert "PR-6" in vs[0].message
+        good = """
+            class Lane:
+                def flush(self, peer, data, toff, tlen, created, now):
+                    sub = stamp_req_tlvs(data, toff, tlen, created, now)
+                    return peer.forward_raw(sub, 4)
+        """
+        assert lint_fixture(tmp_path, good, ["clockdomain"]) == []
+
+
+class TestTracedPurePass:
+    """Host side effects inside jit/shard_map/pallas traces: each
+    diagnostic has a mutant fixture and a blessed/idiomatic twin."""
+
+    def test_lock_acquisition_in_trace_flagged(self, tmp_path):
+        src = """
+            import threading
+            import jax
+
+            _mu = threading.Lock()
+
+            def _impl(x):
+                with _mu:
+                    return x
+
+            step = jax.jit(_impl)
+        """
+        vs = lint_fixture(tmp_path, src, ["tracedpure"])
+        assert len(vs) == 1
+        assert "lock acquisition" in vs[0].message
+        assert "jit(_impl)" in vs[0].message
+
+    def test_metrics_write_in_trace_flagged(self, tmp_path):
+        src = """
+            import jax
+
+            def _impl(x, counter):
+                counter.inc()
+                return x
+
+            step = jax.jit(_impl)
+        """
+        vs = lint_fixture(tmp_path, src, ["tracedpure"])
+        assert len(vs) == 1 and "metrics write" in vs[0].message
+
+    def test_clock_read_in_trace_flagged(self, tmp_path):
+        src = """
+            import time
+            import jax
+
+            def _impl(x):
+                t0 = time.time()
+                return x
+
+            step = jax.jit(_impl)
+        """
+        vs = lint_fixture(tmp_path, src, ["tracedpure"])
+        assert len(vs) == 1 and "host clock read" in vs[0].message
+
+    def test_violation_reached_through_call_graph(self, tmp_path):
+        src = """
+            import time
+            import jax
+
+            def _helper(x):
+                time.sleep(0.1)
+                return x
+
+            def _impl(x):
+                return _helper(x)
+
+            step = jax.jit(_impl)
+        """
+        vs = lint_fixture(tmp_path, src, ["tracedpure"])
+        assert len(vs) == 1
+        assert "time.sleep" in vs[0].message or "host clock" in vs[0].message
+
+    def test_undeclared_callback_flagged_blessed_twin_clean(self, tmp_path):
+        bad = """
+            import jax
+
+            def _hook(v):
+                pass
+
+            def _impl(x):
+                jax.debug.callback(_hook, x)
+                return x
+
+            step = jax.jit(_impl)
+        """
+        vs = lint_fixture(tmp_path, bad, ["tracedpure"])
+        assert len(vs) == 1 and "host callback" in vs[0].message
+        good = bad.replace(
+            "jax.debug.callback(_hook, x)",
+            "jax.debug.callback(_hook, x)  # traced-ok: test-only hook")
+        assert lint_fixture(tmp_path, good, ["tracedpure"]) == []
+
+    def test_blessed_compound_header_skips_body_and_traversal(self, tmp_path):
+        # blessing the guard's HEADER line must also stop traversal
+        # into the callback target (its module-global store is part of
+        # the declared escape) — even when the guard sits inside a loop
+        src = """
+            import jax
+
+            _CHECKS = {"n": 0}
+
+            def _hook(v):
+                _CHECKS["n"] += 1
+
+            def _impl(x):
+                for i in range(2):
+                    if True:  # traced-ok: test-only invariant hook
+                        jax.debug.callback(_hook, x)
+                return x
+
+            step = jax.jit(_impl)
+        """
+        assert lint_fixture(tmp_path, src, ["tracedpure"]) == []
+
+    def test_module_global_store_flagged_ref_store_exempt(self, tmp_path):
+        bad = """
+            import jax
+
+            _COUNTS = {"a": 0}
+
+            def _impl(x):
+                _COUNTS["a"] = 1
+                return x
+
+            step = jax.jit(_impl)
+        """
+        vs = lint_fixture(tmp_path, bad, ["tracedpure"])
+        assert len(vs) == 1
+        assert "module global '_COUNTS'" in vs[0].message
+        # the Pallas Ref-store idiom: a closure-captured out-ref
+        # written by subscript inside a kernel body is a DEVICE write
+        good = """
+            import jax
+
+            def _kernel(x_ref, o_ref):
+                def body(i, acc):
+                    o_ref[i] = acc
+                    return acc
+                return jax.lax.fori_loop(0, 4, body, x_ref[0])
+
+            step = jax.jit(_kernel)
+        """
+        assert lint_fixture(tmp_path, good, ["tracedpure"]) == []
+
+    def test_use_after_donate_flagged_rebind_clean(self, tmp_path):
+        bad = """
+            import jax
+
+            _write = jax.jit(_write_impl, donate_argnums=0)
+
+            def advance(state, x):
+                out = _write(state, x)
+                return state
+        """
+        vs = lint_fixture(tmp_path, bad, ["tracedpure"])
+        assert len(vs) == 1
+        assert "use after donate" in vs[0].message
+        good = """
+            import jax
+
+            _write = jax.jit(_write_impl, donate_argnums=0)
+
+            def advance(state, x):
+                state = _write(state, x)
+                return state
+        """
+        assert lint_fixture(tmp_path, good, ["tracedpure"]) == []
+
+
+class TestRetracePass:
+    def test_dtype_drift_across_sites_flagged(self, tmp_path):
+        src = """
+            import jax
+
+            f = jax.jit(_impl)
+
+            def a(x):
+                return f(x, 3)
+
+            def b(x):
+                return f(x, 3.0)
+        """
+        vs = lint_fixture(tmp_path, src, ["retrace"])
+        assert len(vs) == 1
+        assert "dtype drift at position 1" in vs[0].message
+        assert "py-float" in vs[0].message and "py-int" in vs[0].message
+
+    def test_consistent_sites_clean(self, tmp_path):
+        src = """
+            import jax
+
+            f = jax.jit(_impl)
+
+            def a(x):
+                return f(x, 3)
+
+            def b(x):
+                return f(x, 4)
+        """
+        assert lint_fixture(tmp_path, src, ["retrace"]) == []
+
+    def test_pinned_np_dtype_vs_py_scalar_is_drift(self, tmp_path):
+        src = """
+            import jax
+            import numpy as np
+
+            f = jax.jit(_impl)
+
+            def a(x):
+                return f(x, np.int64(3))
+
+            def b(x):
+                return f(x, 3)
+        """
+        vs = lint_fixture(tmp_path, src, ["retrace"])
+        assert len(vs) == 1 and "int64" in vs[0].message
+
+    def test_retrace_ok_bless_clears_drift(self, tmp_path):
+        src = """
+            import jax
+
+            f = jax.jit(_impl)
+
+            def a(x):
+                return f(x, 3)
+
+            def b(x):
+                return f(x, 3.0)  # retrace-ok: cold path, compiles once
+        """
+        assert lint_fixture(tmp_path, src, ["retrace"]) == []
+
+    def test_unhashable_static_flagged_tuple_clean(self, tmp_path):
+        bad = """
+            import jax
+
+            g = jax.jit(_impl, static_argnums=1)
+
+            def go(x):
+                return g(x, [1, 2])
+        """
+        vs = lint_fixture(tmp_path, bad, ["retrace"])
+        assert len(vs) == 1
+        assert "unhashable static" in vs[0].message
+        assert "EVERY call" in vs[0].message
+        good = bad.replace("[1, 2]", "(1, 2)")
+        assert lint_fixture(tmp_path, good, ["retrace"]) == []
+
+    def test_unhashable_static_kwarg_flagged(self, tmp_path):
+        src = """
+            import jax
+
+            g = jax.jit(_impl, static_argnames="opts")
+
+            def go(x):
+                return g(x, opts=[1])
+        """
+        vs = lint_fixture(tmp_path, src, ["retrace"])
+        assert len(vs) == 1 and "opts=" in vs[0].message
+
+
+class TestDocsPassAndShim:
+    def test_docs_pass_clean_at_head(self):
+        vs = run_passes(passes=["docs"])
+        assert vs == [], [v.render() for v in vs]
+
+    def test_docs_problems_map_to_violations(self, monkeypatch):
+        from tools.guberlint import docs
+
+        monkeypatch.setattr(docs, "metric_catalog_problems",
+                            lambda: ["metric gubernator_fake is fake"])
+        vs = [v for v in docs.run(None) if "fake" in v.message]
+        assert len(vs) == 1
+        assert vs[0].pass_id == "docs"
+        assert vs[0].path == "OBSERVABILITY.md"
+
+    def test_check_metrics_shim_reexports_docs(self):
+        import tools.check_metrics as cm
+        from tools.guberlint import docs
+
+        assert cm.main is docs.main
+        assert cm.emitted_event_kinds is docs.emitted_event_kinds
+        assert cm.main() == 0  # the old CLI contract: 0 on a clean tree
+
+
+class TestBaselineMechanism:
+    BAD = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._n = 0  # guarded-by: self._mu
+
+            def peek(self):
+                return self._n
+    """
+
+    def test_baseline_suppresses_by_key_not_line(self, tmp_path):
+        from tools.guberlint import baseline_key
+
+        mod = tmp_path / "fixture_mod.py"
+        mod.write_text(textwrap.dedent(self.BAD))
+        vs = [v for v in run_passes(passes=["guarded"], extra_files=[mod])
+              if v.path.endswith("fixture_mod.py")]
+        assert len(vs) == 1
+        key = baseline_key(vs[0])
+        assert str(vs[0].line) not in key  # line-free: survives edits
+        suppressed = [
+            v for v in run_passes(passes=["guarded"], extra_files=[mod],
+                                  baseline={key})
+            if v.path.endswith("fixture_mod.py")]
+        assert suppressed == []
+
+    def test_load_baseline_ignores_comments_and_missing(self, tmp_path):
+        from tools.guberlint import load_baseline
+
+        f = tmp_path / "base.txt"
+        f.write_text("# header\n\na.py [guarded] boom\n")
+        assert load_baseline(f) == {"a.py [guarded] boom"}
+        assert load_baseline(tmp_path / "nope.txt") == set()
+
+    def test_write_baseline_cli_roundtrip(self, tmp_path, capsys):
+        from tools.guberlint.__main__ import main
+
+        out = tmp_path / "base.txt"
+        assert main(["--write-baseline", str(out)]) == 0
+        # HEAD is clean, so the baseline is empty (header only) — and
+        # feeding it back changes nothing
+        assert main(["--baseline", str(out)]) == 0

@@ -25,16 +25,37 @@ Passes (each one module in this package):
 - ``threads``   — every Thread(...) names itself (``name=``) and no
   ``.join()`` runs unbounded (a dead worker must never hang drain
   forever — joins carry a timeout).
+- ``clockdomain`` — clock-domain taint (ISSUE 14): every clock read
+  declares ``# clock-domain: caller|owner``; owner-domain values
+  must not become created_at stamps; deferred-apply queue/forward
+  sinks must show their caller stamp — the PR-6 created_at
+  clock-mixing loss as a lint error.
+- ``tracedpure`` — no host side effects inside jit/shard_map/pallas
+  traces: lock acquisition, metrics/telemetry writes, faultpoint
+  checks, ``time.*``, non-local Python mutation, undeclared host
+  callbacks, use-after-donate.  Escapes: ``# traced-ok: <reason>``.
+- ``retrace``   — jit call sites must be retrace-stable: no dtype
+  drift across a positional slot, no unhashable statics.  Escapes:
+  ``# retrace-ok: <reason>``.  Cross-checked at runtime by the
+  compile ledger (gubernator_tpu/compileledger.py).
+- ``docs``      — the operator-doc consistency family (née
+  tools/check_metrics.py): metrics ↔ OBSERVABILITY.md, event kinds,
+  faultpoints ↔ RESILIENCE.md, GUBER_* table, SLO + span catalogs.
 
 Annotation grammar (full spec in CONCURRENCY.md):
 
     self._inflight = {}          # guarded-by: self._tel_mu
     depth = self._queued_rows    # lock-free: GIL-atomic int read
     def stats(self):             # lock-free: snapshot, staleness ok
+    now = clock_ms()             # clock-domain: caller
+    t0 = time.time()             # clock-ok: telemetry wall clock
+    jax.debug.callback(hook, x)  # traced-ok: test-only invariant hook
+    f(x, 3.0)                    # retrace-ok: cold path, compiles once
 
-A ``# lock-free:`` on a ``def`` line blesses the whole function body.
-Declaring assignments and the whole constructor (``__init__``) are
-exempt — construction happens-before publication.
+A ``# lock-free:`` / ``# clock-domain:`` / ``# traced-ok:`` on a
+``def`` line blesses the whole function body.  Declaring assignments
+and the whole constructor (``__init__``) are exempt for ``guarded`` —
+construction happens-before publication.
 """
 from __future__ import annotations
 
@@ -58,20 +79,47 @@ class Violation:
 
 #: pass registry, populated lazily (each pass module exposes
 #: ``run(ctx) -> List[Violation]``)
-PASS_NAMES = ("guarded", "lockorder", "envreg", "faultcat", "threads")
+PASS_NAMES = ("guarded", "lockorder", "envreg", "faultcat", "threads",
+              "clockdomain", "tracedpure", "retrace", "docs")
 
 
 def repo_root() -> Path:
     return Path(__file__).resolve().parent.parent.parent
 
 
+def baseline_key(v: Violation) -> str:
+    """The line-number-free identity a baseline file suppresses on —
+    line numbers drift with every edit, so suppressions pin
+    (path, pass, message) instead."""
+    return f"{v.path} [{v.pass_id}] {v.message}"
+
+
+def load_baseline(path) -> set:
+    """Suppression keys from a ``--baseline`` file (one
+    :func:`baseline_key` line each; blank lines and ``#`` comments
+    ignored).  Missing file → empty set (a deleted baseline means
+    nothing is suppressed, not an error)."""
+    p = Path(path)
+    if not p.exists():
+        return set()
+    out = set()
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            out.add(line)
+    return out
+
+
 def run_passes(root: Optional[Path] = None,
                passes: Optional[Iterable[str]] = None,
-               extra_files: Optional[List[Path]] = None
+               extra_files: Optional[List[Path]] = None,
+               baseline: Optional[set] = None
                ) -> List[Violation]:
     """Run the requested passes (default: all) over the repo rooted at
     ``root``; returns violations sorted by (path, line).  ``extra_files``
-    adds out-of-tree sources (the fixture tests use this)."""
+    adds out-of-tree sources (the fixture tests use this).
+    ``baseline`` is a set of :func:`baseline_key` strings to suppress —
+    the incremental-landing mechanism for future passes."""
     import importlib
 
     from .engine import LintContext
@@ -86,4 +134,6 @@ def run_passes(root: Optional[Path] = None,
                 f"{', '.join(PASS_NAMES)})")
         mod = importlib.import_module(f".{name}", __package__)
         out.extend(mod.run(ctx))
+    if baseline:
+        out = [v for v in out if baseline_key(v) not in baseline]
     return sorted(out, key=lambda v: (v.path, v.line, v.pass_id))

@@ -3,14 +3,21 @@
 Exit 0 on a clean tree, 1 with one ``path:line: [pass] message`` line
 per violation.  ``--pass`` restricts to one pass; ``--json`` emits the
 violations as a JSON list (bench provenance uses this).
+
+``--baseline FILE`` suppresses violations whose line-number-free key
+(``path [pass] message``) appears in FILE — the mechanism for landing
+a new pass incrementally against a not-yet-clean tree.
+``--write-baseline FILE`` regenerates that file from the current
+violations (and exits 0: writing a baseline IS the acknowledgement).
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+from pathlib import Path
 
-from . import PASS_NAMES, run_passes
+from . import PASS_NAMES, baseline_key, load_baseline, run_passes
 
 
 def main(argv=None) -> int:
@@ -22,8 +29,26 @@ def main(argv=None) -> int:
                     help="run only this pass (repeatable)")
     ap.add_argument("--json", action="store_true",
                     help="emit violations as JSON")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="suppress violations listed in FILE "
+                         "(line-number-free 'path [pass] message' keys)")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write the current violations to FILE as a "
+                         "baseline and exit 0")
     args = ap.parse_args(argv)
-    violations = run_passes(passes=args.passes)
+    baseline = load_baseline(args.baseline) if args.baseline else None
+    violations = run_passes(passes=args.passes, baseline=baseline)
+    if args.write_baseline:
+        keys = sorted({baseline_key(v) for v in violations})
+        Path(args.write_baseline).write_text(
+            "# guberlint baseline — suppressed violations "
+            "(regenerate: python -m tools.guberlint "
+            "--write-baseline <file>)\n"
+            + "".join(k + "\n" for k in keys))
+        print(f"guberlint: wrote {len(keys)} baseline "
+              f"key{'s' if len(keys) != 1 else ''} to "
+              f"{args.write_baseline}")
+        return 0
     if args.json:
         print(json.dumps([v.__dict__ for v in violations], indent=2))
     else:

@@ -1,0 +1,317 @@
+"""clockdomain — clock-domain taint: the PR-6 bug class as a lint error.
+
+The worst bug this repo has shipped mixed two *time bases* in one
+bucket row: a forwarded request was applied at the OWNER's wall clock
+instead of the CALLER's, the later base read the earlier one as
+expired, and the bucket reset ate the debits (CONCURRENCY.md › racer;
+fixed by forwarding ``created_at``, RateLimitReq field 10).  That bug
+lived in dataflow, not annotations — this pass makes the flow itself
+checkable, in three rules over the core package:
+
+**Rule A — every clock read declares its domain.**  Each call to
+``clock_ms()`` / ``time.time()`` / ``time.time_ns()`` must carry one of
+
+    now = clock_ms()        # clock-domain: caller
+    now = clock_ms()        # clock-domain: owner
+    t0 = time.time()        # clock-ok: <reason — not a bucket time base>
+
+on its statement (or the line above it, or the enclosing ``def`` line).
+``caller`` means the read happens at the request's first hop (the
+daemon IS the caller's entry — front doors); ``owner`` means the read
+happens while applying rows that originated elsewhere (peer-wire hops,
+deferred queue flushes).  ``# clock-ok:`` is for wall-clock reads that
+are never a rate-limit time base (telemetry, tracing, sweep cadence).
+
+**Rule B — owner-domain values must not become created_at stamps.**
+Intra-function taint: names assigned from an owner-domain read (through
+assignments, ternaries, arithmetic) must not reach a stamping sink — a
+``created_at=`` / ``stamp_ms=`` keyword, ``tlv_with_created``'s time
+argument, ``stamp_req_tlvs``'s time argument — unless the statement is
+blessed with ``# clock-ok: <reason>`` (the legal reason in the tree:
+first-hop-wins fallback stamps that only apply to rows no caller ever
+stamped).
+
+**Rule C — deferred-apply sinks must carry a caller stamp.**  Every
+call site of a queue/egress sink whose rows are applied LATER under a
+different clock must show its stamp lexically:
+
+- ``queue_hits(...)`` (GLOBAL / multi-region object path): an argument
+  derived from ``_req_stamped(...)`` / ``tlv_with_created(...)`` /
+  ``stamp_req_tlvs(...)``;
+- ``_raw_queue_groups(...)`` / ``_queue_mr_raw(...)`` (wire lane): a
+  ``stamp_ms=`` keyword;
+- ``forward_raw(...)`` (peer forward hop): a stamping call somewhere in
+  the enclosing function (the stamp is applied to the TLV bytes being
+  forwarded, not at the send call itself);
+
+or carry ``# clock-ok: <reason>``.  Reverting a stamp site — the exact
+PR-6 regression — trips this rule (sharpness pinned by the fixture
+tests in tests/test_guberlint.py).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import Violation
+from .engine import LintContext, unparse
+
+PASS_ID = "clockdomain"
+
+#: direct clock-source function names (the rate-limit time base)
+_CLOCK_NAMES = {"clock_ms"}
+#: ``<module>.<attr>()`` clock sources
+_TIME_MODULES = {"time", "_time"}
+_TIME_ATTRS = {"time", "time_ns"}
+
+#: stamping sinks: (positional index or None) checked for owner taint
+_STAMP_POS = {"tlv_with_created": 1, "stamp_req_tlvs": -1}
+_STAMP_KWARGS = {"created_at", "stamp_ms"}
+
+#: functions whose presence proves a caller stamp was applied
+_STAMP_EVIDENCE = {"_req_stamped", "tlv_with_created", "stamp_req_tlvs"}
+
+#: deferred-apply sinks requiring stamp evidence in their arguments
+_ARG_EVIDENCE_SINKS = {"queue_hits"}
+#: deferred-apply sinks requiring a stamp_ms= keyword
+_KWARG_SINKS = {"_raw_queue_groups", "_queue_mr_raw"}
+#: egress sinks requiring stamp evidence in the enclosing function
+_FN_EVIDENCE_SINKS = {"forward_raw"}
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in _CLOCK_NAMES:
+        return True
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _CLOCK_NAMES:
+            return True
+        if (fn.attr in _TIME_ATTRS and isinstance(fn.value, ast.Name)
+                and fn.value.id in _TIME_MODULES):
+            return True
+    return False
+
+
+def _stmt_annotation(sf, stmt: ast.stmt, key: str) -> Optional[str]:
+    """``# key: value`` on the statement's line range or the line
+    above.  Compound statements (if/for/with/...) only honor their
+    HEADER line — an annotation deep inside a body belongs to the
+    nested statement it sits on."""
+    if getattr(stmt, "body", None):
+        lines = (stmt.lineno - 1, stmt.lineno)
+    else:
+        end = getattr(stmt, "end_lineno", None) or stmt.lineno
+        lines = range(stmt.lineno - 1, end + 1)
+    for line in lines:
+        v = sf.annotation(line, key)
+        if v:
+            return v
+    return None
+
+
+def _domain(sf, stmt: ast.stmt, fn_stack) -> Optional[str]:
+    """Resolved clock domain for a clock read inside ``stmt``:
+    'caller' / 'owner' / 'ok' (blessed) / None (untagged)."""
+    v = _stmt_annotation(sf, stmt, "clock-domain")
+    if v in ("caller", "owner"):
+        return v
+    if _stmt_annotation(sf, stmt, "clock-ok"):
+        return "ok"
+    for fn in reversed(fn_stack):
+        v = sf.annotation(fn.lineno, "clock-domain")
+        if v in ("caller", "owner"):
+            return v
+        if sf.annotation(fn.lineno, "clock-ok"):
+            return "ok"
+    return None
+
+
+def _blessed(sf, stmt: ast.stmt, fn_stack) -> bool:
+    if _stmt_annotation(sf, stmt, "clock-ok"):
+        return True
+    return any(sf.annotation(fn.lineno, "clock-ok") for fn in fn_stack)
+
+
+class _FnAuditor:
+    """One function (or the module body): Rule A on every clock read,
+    Rule B forward taint, Rule C sink-site stamping."""
+
+    def __init__(self, sf, fn_stack, out: List[Violation]):
+        self.sf = sf
+        self.fn_stack = fn_stack  # enclosing (Async)FunctionDefs
+        self.out = out
+        self.tainted: Set[str] = set()
+        self.fn_has_evidence = False
+
+    def run(self, body) -> None:
+        # function-scope pre-scan: is a stamping call present anywhere?
+        # (Rule C's forward_raw sinks stamp the bytes upstream in the
+        # same function, not at the send call)
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if (isinstance(n, ast.Call)
+                        and _call_name(n) in _STAMP_EVIDENCE):
+                    self.fn_has_evidence = True
+        self._stmts(body)
+
+    # -- statement walk (source order; branch taint is unioned) --------
+
+    def _stmts(self, body) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FnAuditor(self.sf, self.fn_stack + [stmt],
+                       self.out).run(stmt.body)
+            return
+        self._check_clock_reads(stmt)
+        self._check_sinks(stmt)
+        self._propagate(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            self._stmts(getattr(stmt, field, []) or [])
+        for h in getattr(stmt, "handlers", []) or []:
+            self._stmts(h.body)
+
+    # -- Rule A ---------------------------------------------------------
+
+    def _check_clock_reads(self, stmt: ast.stmt) -> None:
+        for n in self._own_nodes(stmt):
+            if _is_clock_call(n) and \
+                    _domain(self.sf, stmt, self.fn_stack) is None:
+                self.out.append(Violation(
+                    self.sf.rel, n.lineno, PASS_ID,
+                    f"untagged clock read {unparse(n.func)}() — declare "
+                    f"its time base with '# clock-domain: caller|owner' "
+                    f"(or '# clock-ok: <reason>' for non-bucket wall "
+                    f"clock); see CONCURRENCY.md"))
+
+    # -- Rule B / Rule C ------------------------------------------------
+
+    def _check_sinks(self, stmt: ast.stmt) -> None:
+        for n in self._own_nodes(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            # Rule B: owner taint into a stamping slot
+            checked = []
+            if name in _STAMP_POS and n.args:
+                idx = _STAMP_POS[name]
+                if idx == -1 or idx < len(n.args):
+                    checked.append(n.args[idx])
+            for kw in n.keywords:
+                if kw.arg in _STAMP_KWARGS:
+                    checked.append(kw.value)
+            for expr in checked:
+                if self._tainted_expr(expr, stmt) and \
+                        not _blessed(self.sf, stmt, self.fn_stack):
+                    self.out.append(Violation(
+                        self.sf.rel, n.lineno, PASS_ID,
+                        f"owner-domain clock value flows into the "
+                        f"created_at stamp of {name}(...) — forwarded "
+                        f"rows must carry the CALLER's time base, or "
+                        f"the owner's clock resets cold bucket rows "
+                        f"(bless first-hop-wins fallbacks with "
+                        f"'# clock-ok: <reason>')"))
+            # Rule C: deferred-apply sinks must show their stamp
+            if name in _ARG_EVIDENCE_SINKS:
+                ok = any(isinstance(sub, ast.Call)
+                         and _call_name(sub) in _STAMP_EVIDENCE
+                         for a in n.args for sub in ast.walk(a))
+                if not ok and not _blessed(self.sf, stmt, self.fn_stack):
+                    self.out.append(Violation(
+                        self.sf.rel, n.lineno, PASS_ID,
+                        f"{name}(...) enqueues rows for deferred apply "
+                        f"without a created_at stamp — wrap the request "
+                        f"in _req_stamped(...) (or bless with "
+                        f"'# clock-ok: <reason>'): the PR-6 bug class"))
+            elif name in _KWARG_SINKS:
+                if not any(kw.arg == "stamp_ms" for kw in n.keywords) \
+                        and not _blessed(self.sf, stmt, self.fn_stack):
+                    self.out.append(Violation(
+                        self.sf.rel, n.lineno, PASS_ID,
+                        f"{name}(...) without stamp_ms= — wire-lane "
+                        f"queue TLVs apply at the owner later and must "
+                        f"carry the caller's created_at (or bless with "
+                        f"'# clock-ok: <reason>'): the PR-6 bug class"))
+            elif name in _FN_EVIDENCE_SINKS:
+                if not self.fn_has_evidence and \
+                        not _blessed(self.sf, stmt, self.fn_stack):
+                    self.out.append(Violation(
+                        self.sf.rel, n.lineno, PASS_ID,
+                        f"{name}(...) forwards request TLVs but no "
+                        f"stamping call (stamp_req_tlvs / "
+                        f"tlv_with_created / _req_stamped) appears in "
+                        f"this function — the owner would apply these "
+                        f"rows at its own clock (the PR-6 bug class); "
+                        f"stamp before sending or bless with "
+                        f"'# clock-ok: <reason>'"))
+
+    # -- taint machinery ------------------------------------------------
+
+    def _tainted_expr(self, expr: ast.AST, stmt: ast.stmt) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in self.tainted:
+                return True
+            if _is_clock_call(n) and \
+                    _domain(self.sf, stmt, self.fn_stack) == "owner":
+                return True
+        return False
+
+    def _propagate(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            t = self._tainted_expr(stmt.value, stmt)
+            for tgt in stmt.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        (self.tainted.add if t
+                         else self.tainted.discard)(n.id)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if isinstance(stmt.target, ast.Name):
+                if self._tainted_expr(stmt.value, stmt):
+                    self.tainted.add(stmt.target.id)
+                else:
+                    self.tainted.discard(stmt.target.id)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name) and \
+                    self._tainted_expr(stmt.value, stmt):
+                self.tainted.add(stmt.target.id)
+
+    # -- helpers --------------------------------------------------------
+
+    def _own_nodes(self, stmt: ast.stmt):
+        """Expression nodes belonging to this statement but NOT to a
+        nested statement body (those are visited on their own, so their
+        annotations resolve against the right line range)."""
+        nested = []
+        for field in ("body", "orelse", "finalbody"):
+            nested.extend(getattr(stmt, field, []) or [])
+        for h in getattr(stmt, "handlers", []) or []:
+            nested.extend(h.body)
+        skip = set()
+        for s in nested:
+            for n in ast.walk(s):
+                skip.add(id(n))
+        for n in ast.walk(stmt):
+            if id(n) not in skip:
+                yield n
+
+
+def run(ctx: LintContext) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in ctx.core_files():
+        # module body + each top-level/nested function as its own scope
+        _FnAuditor(sf, [], out).run(sf.tree.body)
+    return out

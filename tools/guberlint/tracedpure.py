@@ -1,0 +1,478 @@
+"""tracedpure — no host side effects inside jit/shard_map/pallas traces.
+
+Python inside ``jax.jit`` / ``shard_map`` / ``pallas_call`` runs ONCE,
+at trace time — then never again.  A lock acquisition, metrics bump,
+faultpoint check, wall-clock read, or mutation of non-local Python
+state inside traced code therefore does the wrong thing twice over: it
+executes at trace time (when no request is in flight) and is silently
+absent from every steady-state wave.  The classic symptom is a counter
+that advances exactly once per compile and then freezes — invisible in
+tests that trigger a compile per call, wrong in production.
+
+The pass builds the call graph rooted at every traced entry point —
+the first argument of each ``jax.jit(...)`` / ``shard_map(...)`` /
+``pallas_call(...)`` call (Name, lambda, or ``functools.partial``),
+plus ``@jit``-decorated defs — resolving callees in the same file
+first (including ``self.method``), then across the core package when
+the name is globally unique, and audits every reached function for:
+
+- lock acquisition (``with <lock>``, ``.acquire()``);
+- metrics writes (``.inc()``, ``.observe()``, ``.labels()``) and
+  telemetry (``.record()``, ``.record_error()``, ``.tap_flag()``);
+- faultpoint checks (``self._fault(...)``, ``fs.fire/should(...)``);
+- host clock reads (``time.*``, ``clock_ms``);
+- mutation of non-local Python state (``global`` / ``nonlocal``,
+  attribute assignment, subscript stores to module-level names —
+  closure-captured subscript writes are exempt: that shape is the
+  Pallas Ref-store idiom (``o_ref[...] = x`` inside a kernel's loop
+  body), a device write, not host state);
+- host callbacks (``jax.debug.callback`` / ``io_callback``) — legal
+  escape hatches, but each must be *declared*;
+- use-after-donate: an argument passed at a donated position of a
+  ``jax.jit(..., donate_argnums=...)`` callable is dead after the call
+  — reading it again aliases freed device memory.
+
+Intentional escapes are blessed with ``# traced-ok: <reason>`` on the
+statement (or the line above, or the ``def`` line for a whole
+function).  Every ``# traced-ok:`` needs a REASON — the legal ones in
+the tree today: trace-time-only constant reads, and debug callbacks
+gated behind test-only flags.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Violation
+from .engine import LintContext, unparse
+
+PASS_ID = "tracedpure"
+
+_ENTRY_NAMES = {"jit", "shard_map", "pallas_call"}
+_LOCK_RX = re.compile(r"(_mu\b|_lock\b|_cond\b|XLA_EXEC_MU|"
+                      r"\bLock\(|\bRLock\(|\bCondition\()")
+_METRIC_ATTRS = {"inc", "observe", "labels"}
+_TELEMETRY_ATTRS = {"record", "record_error", "_record_event",
+                    "tap_flag", "force_sample"}
+_FAULT_NAMES = {"_fault", "_fault_point", "_fault_tick"}
+_TIME_ATTRS = {"time", "time_ns", "perf_counter", "monotonic", "sleep"}
+_CALLBACK_ATTRS = {"callback", "io_callback", "pure_callback"}
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _is_entry(node: ast.Call) -> bool:
+    return _call_name(node) in _ENTRY_NAMES
+
+
+def _stmt_blessed(sf, stmt: ast.stmt, key: str = "traced-ok") -> bool:
+    """Blessed on the statement's own lines or the line above.  For
+    compound statements (if/for/with/...) only the HEADER line counts —
+    an annotation deep inside a long body blesses the nested statement
+    it sits on, not the whole block."""
+    if getattr(stmt, "body", None):
+        lines = (stmt.lineno - 1, stmt.lineno)
+    else:
+        end = getattr(stmt, "end_lineno", None) or stmt.lineno
+        lines = range(stmt.lineno - 1, end + 1)
+    return any(sf.annotation(line, key) for line in lines)
+
+
+def _callable_candidates(arg: ast.AST):
+    """Yield the Name / Lambda nodes an entry-point argument may call
+    (unwraps functools.partial)."""
+    if isinstance(arg, (ast.Name, ast.Lambda)):
+        yield arg
+    elif isinstance(arg, ast.Call) and _call_name(arg) == "partial" \
+            and arg.args:
+        yield from _callable_candidates(arg.args[0])
+
+
+def _donate_indices(call: ast.Call) -> Tuple[int, ...]:
+    """Donated positional indices of a jit(...) call, () if none."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in v.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, int))
+    return ()
+
+
+class _Index:
+    """Function-def resolution: same-file first, then globally-unique
+    names across the core package, plus per-file core-module aliases."""
+
+    def __init__(self, ctx: LintContext):
+        self.by_file: Dict[str, Dict[str, list]] = {}
+        self.global_idx: Dict[str, list] = {}
+        self.aliases: Dict[str, Set[str]] = {}
+        self.module_globals: Dict[str, Set[str]] = {}
+        for sf in ctx.core_files():
+            g: Set[str] = set()
+            for stmt in sf.tree.body:
+                for tgt in getattr(stmt, "targets", None) \
+                        or ([stmt.target] if isinstance(
+                            stmt, (ast.AnnAssign, ast.AugAssign))
+                            else []):
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            g.add(n.id)
+            self.module_globals[sf.rel] = g
+        for sf in ctx.core_files():
+            d: Dict[str, list] = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    d.setdefault(node.name, []).append(node)
+                    self.global_idx.setdefault(node.name, []) \
+                        .append((sf, node))
+            self.by_file[sf.rel] = d
+            al: Set[str] = set()
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ImportFrom) and node.level:
+                    al.update(a.asname or a.name for a in node.names)
+                elif isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name.startswith("gubernator_tpu"):
+                            al.add(a.asname or a.name.split(".")[0])
+            self.aliases[sf.rel] = al
+
+    def resolve(self, sf, call: ast.Call):
+        """(sf, FunctionDef) for a call, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self._by_name(sf, fn.id)
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value,
+                                                        ast.Name):
+            base = fn.value.id
+            if base == "self":
+                local = self.by_file.get(sf.rel, {}).get(fn.attr, [])
+                if len(local) == 1:
+                    return sf, local[0]
+                return None
+            if base in self.aliases.get(sf.rel, set()):
+                hits = self.global_idx.get(fn.attr, [])
+                if len(hits) == 1:
+                    return hits[0]
+        return None
+
+    def _by_name(self, sf, name: str):
+        local = self.by_file.get(sf.rel, {}).get(name, [])
+        if len(local) == 1:
+            return sf, local[0]
+        if not local:
+            hits = self.global_idx.get(name, [])
+            if len(hits) == 1:
+                return hits[0]
+        return None
+
+
+class _TraceAuditor:
+    def __init__(self, idx: _Index, out: List[Violation]):
+        self.idx = idx
+        self.out = out
+        self.visited: Set[Tuple[str, int]] = set()
+
+    def audit(self, sf, fn, root: str) -> None:
+        key = (sf.rel, fn.lineno)
+        if key in self.visited:
+            return
+        self.visited.add(key)
+        if not isinstance(fn, ast.Lambda) and \
+                sf.annotation(fn.lineno, "traced-ok"):
+            return  # whole function blessed
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        locals_: Set[str] = set()
+        for a in ast.walk(fn.args):
+            if isinstance(a, ast.arg):
+                locals_.add(a.arg)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                locals_.add(n.id)
+        if isinstance(fn.body, list):
+            self._stmts(sf, fn, body, locals_, root)
+        else:  # lambda: one expression, no statements to bless
+            self._expr_checks(sf, fn, fn.body, frozenset(), locals_,
+                              root)
+            self._follow_calls(sf, fn.body, root)
+
+    # -- statement walk -------------------------------------------------
+
+    def _stmts(self, sf, fn, body, locals_, root) -> None:
+        for stmt in body:
+            self._stmt(sf, fn, stmt, locals_, root)
+
+    def _stmt(self, sf, fn, stmt, locals_, root) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # defined inside traced code → runs under the trace when
+            # called (cond branches, fori bodies): audit it too
+            self.audit(sf, stmt, root)
+            return
+        if _stmt_blessed(sf, stmt):
+            return  # checks AND traversal skipped: declared escape
+        # nested statements are walked individually below (where their
+        # own blessings apply) — exclude them from this statement's
+        # expression checks AND call-following, else a blessed nested
+        # statement leaks through the enclosing compound's walk
+        skip = self._nested_stmt_ids(stmt)
+        self._stmt_checks(sf, fn, stmt, locals_, root)
+        self._expr_checks(sf, fn, stmt, skip, locals_, root)
+        self._follow_calls(sf, stmt, root, skip)
+        for field in ("body", "orelse", "finalbody"):
+            self._stmts(sf, fn, getattr(stmt, field, []) or [],
+                        locals_, root)
+        for h in getattr(stmt, "handlers", []) or []:
+            self._stmts(sf, fn, h.body, locals_, root)
+
+    def _stmt_checks(self, sf, fn, stmt, locals_, root) -> None:
+        if isinstance(stmt, (ast.Global, ast.Nonlocal)):
+            self._flag(sf, stmt.lineno, root,
+                       f"'{unparse(stmt)}' mutates non-local Python "
+                       f"state inside traced code — the write happens "
+                       f"once at trace time, never per wave")
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                text = unparse(item.context_expr).replace(" ", "")
+                if _LOCK_RX.search(text):
+                    self._flag(sf, stmt.lineno, root,
+                               f"lock acquisition 'with {text}' inside "
+                               f"traced code — held at trace time only, "
+                               f"guards nothing per wave")
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for tgt in targets:
+            for t in (tgt.elts if isinstance(tgt, (ast.Tuple, ast.List))
+                      else [tgt]):
+                if isinstance(t, ast.Attribute):
+                    self._flag(sf, stmt.lineno, root,
+                               f"attribute mutation "
+                               f"'{unparse(t)} = ...' inside traced "
+                               f"code — happens once at trace time, "
+                               f"never per wave")
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id not in locals_ and \
+                        t.value.id in self.idx.module_globals.get(
+                            sf.rel, set()):
+                    self._flag(sf, stmt.lineno, root,
+                               f"subscript store to module global "
+                               f"'{t.value.id}' inside traced code — "
+                               f"happens once at trace time, never "
+                               f"per wave")
+
+    @staticmethod
+    def _nested_stmt_ids(stmt) -> Set[int]:
+        """ids of every node under this statement's nested statement
+        bodies (if/for/try arms)."""
+        nested = []
+        for field in ("body", "orelse", "finalbody"):
+            v = getattr(stmt, field, None)
+            if isinstance(v, list):
+                nested.extend(v)
+        for h in getattr(stmt, "handlers", []) or []:
+            nested.extend(h.body)
+        return {id(n) for s in nested for n in ast.walk(s)}
+
+    def _expr_checks(self, sf, fn, node, skip, locals_, root) -> None:
+        for n in ast.walk(node):
+            if id(n) in skip or not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            f = n.func
+            if name == "acquire":
+                self._flag(sf, n.lineno, root,
+                           f"{unparse(f)}() inside traced code — lock "
+                           f"taken at trace time only")
+            elif isinstance(f, ast.Attribute) and \
+                    name in _METRIC_ATTRS and \
+                    not self._is_jnp_set_chain(f):
+                self._flag(sf, n.lineno, root,
+                           f"metrics write {unparse(f)}(...) inside "
+                           f"traced code — bumps once at trace time, "
+                           f"then freezes")
+            elif isinstance(f, ast.Attribute) and \
+                    name in _TELEMETRY_ATTRS:
+                self._flag(sf, n.lineno, root,
+                           f"telemetry call {unparse(f)}(...) inside "
+                           f"traced code — records once at trace "
+                           f"time, then never again")
+            elif name in _FAULT_NAMES or (
+                    isinstance(f, ast.Attribute)
+                    and name in ("fire", "should")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "fs"):
+                self._flag(sf, n.lineno, root,
+                           f"faultpoint check {unparse(f)}(...) inside "
+                           f"traced code — evaluated at trace time, "
+                           f"the armed fault never fires per wave")
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in _TIME_ATTRS
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in ("time", "_time")) or \
+                    name == "clock_ms":
+                self._flag(sf, n.lineno, root,
+                           f"host clock read {unparse(f)}() inside "
+                           f"traced code — frozen at its trace-time "
+                           f"value in the compiled program")
+            elif isinstance(f, ast.Attribute) and \
+                    name in _CALLBACK_ATTRS:
+                self._flag(sf, n.lineno, root,
+                           f"host callback {unparse(f)}(...) escapes "
+                           f"the trace — declare it with "
+                           f"'# traced-ok: <reason>'")
+
+    @staticmethod
+    def _is_jnp_set_chain(f: ast.Attribute) -> bool:
+        """``x.at[i].set/...`` lookalikes never collide with the metric
+        attrs checked here, but ``.labels`` could in principle — keep
+        the hook for future attr collisions."""
+        return False
+
+    def _follow_calls(self, sf, node, root,
+                      skip=frozenset()) -> None:
+        for n in ast.walk(node):
+            if id(n) in skip or not isinstance(n, ast.Call):
+                continue
+            hit = self.idx.resolve(sf, n)
+            if hit is not None:
+                self.audit(hit[0], hit[1], root)
+            # functions passed as operands (lax.cond branches,
+            # fori_loop bodies) execute under the same trace
+            for a in n.args:
+                if isinstance(a, ast.Name):
+                    h = self.idx._by_name(sf, a.id)
+                    if h is not None:
+                        self.audit(h[0], h[1], root)
+
+    def _flag(self, sf, line: int, root: str, msg: str) -> None:
+        self.out.append(Violation(
+            sf.rel, line, PASS_ID,
+            f"{msg} [traced via {root}; bless intentional escapes "
+            f"with '# traced-ok: <reason>']"))
+
+
+def _use_after_donate(ctx: LintContext, out: List[Violation]) -> None:
+    for sf in ctx.core_files():
+        donated: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if not (isinstance(v, ast.Call) and _call_name(v) == "jit"):
+                continue
+            idxs = _donate_indices(v)
+            if not idxs:
+                continue
+            for tgt in node.targets:
+                donated[unparse(tgt).replace(" ", "")] = idxs
+        if not donated:
+            continue
+        for fn in (n for n in ast.walk(sf.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            _donate_scan(sf, fn, donated, out)
+
+
+def _donate_scan(sf, fn, donated, out: List[Violation]) -> None:
+    """Linear scan: after ``f(x, ...)`` donates ``x`` (and the statement
+    does not rebind it), any later load of ``x`` before a rebind reads
+    freed device memory."""
+    nested_ids = set()
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)) and n is not fn:
+            nested_ids.update(id(s) for s in ast.walk(n))
+    stmts = [s for s in ast.walk(fn)
+             if isinstance(s, ast.stmt) and s is not fn
+             and id(s) not in nested_ids]
+    stmts.sort(key=lambda s: s.lineno)
+    dead: Dict[str, int] = {}  # donated text -> donation line
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        rebound: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                for t in (tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                       ast.List))
+                          else [tgt]):
+                    rebound.add(unparse(t).replace(" ", ""))
+        # loads of dead buffers (skip the rebinding statement's own
+        # RHS only when it is the donation call itself, handled below)
+        if dead and not _stmt_blessed(sf, stmt):
+            for n in ast.walk(stmt):
+                if isinstance(n, (ast.Name, ast.Attribute)) and \
+                        isinstance(getattr(n, "ctx", None), ast.Load):
+                    text = unparse(n).replace(" ", "")
+                    if text in dead:
+                        out.append(Violation(
+                            sf.rel, n.lineno, PASS_ID,
+                            f"use after donate: '{text}' was donated "
+                            f"at line {dead[text]} "
+                            f"(donate_argnums) and read again here — "
+                            f"the buffer's device memory was reused "
+                            f"by XLA; rebind the result first"))
+                        del dead[text]
+        for text in rebound:
+            dead.pop(text, None)
+        # new donations in this statement
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            ftext = unparse(n.func).replace(" ", "")
+            idxs = donated.get(ftext)
+            if not idxs:
+                continue
+            for i in idxs:
+                if i < len(n.args) and isinstance(
+                        n.args[i], (ast.Name, ast.Attribute)):
+                    atext = unparse(n.args[i]).replace(" ", "")
+                    if atext not in rebound:
+                        dead[atext] = n.lineno
+
+
+def run(ctx: LintContext) -> List[Violation]:
+    out: List[Violation] = []
+    idx = _Index(ctx)
+    auditor = _TraceAuditor(idx, out)
+    for sf in ctx.core_files():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _is_entry(node) \
+                    and node.args:
+                for cand in _callable_candidates(node.args[0]):
+                    if isinstance(cand, ast.Lambda):
+                        auditor.audit(sf, cand,
+                                      f"{sf.rel}:{node.lineno}")
+                    else:
+                        hit = idx._by_name(sf, cand.id)
+                        if hit is not None:
+                            auditor.audit(hit[0], hit[1],
+                                          f"jit({cand.id})")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dn = dec if not isinstance(dec, ast.Call) \
+                        else dec.func
+                    name = dn.id if isinstance(dn, ast.Name) else (
+                        dn.attr if isinstance(dn, ast.Attribute)
+                        else "")
+                    if name in _ENTRY_NAMES:
+                        auditor.audit(sf, node, f"@{name} {node.name}")
+    _use_after_donate(ctx, out)
+    return out

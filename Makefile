@@ -3,8 +3,8 @@
 PY ?= python
 
 .PHONY: test proto bench bench-pallas bench-tiered bench-diff chaos \
-        tpu-session b-sweep daemon cluster lint native tsan asan racer \
-        check clean
+        scenarios tpu-session b-sweep daemon cluster lint native tsan \
+        asan racer check clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -42,12 +42,18 @@ racer:
 # CI-style gate: static analysis + sanitizer soaks + the concurrency
 # test subset + the compile-ledger gate (steady-state zero recompiles
 # on the service path); the full tier-1 battery stays `make test`
-check: lint tsan asan
+check: lint tsan asan scenarios
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_guberlint.py \
 	    tests/test_lint_clean.py tests/test_compileledger.py \
 	    tests/test_created_at.py \
 	    tests/test_cold_conservation.py tests/test_native.py \
-	    tests/test_interval.py tests/test_dispatcher.py -q
+	    tests/test_interval.py tests/test_dispatcher.py \
+	    tests/test_scenarios.py -q
+
+# the scenario lab's seeded fast subset (ISSUE 16): every spec in
+# scenarios/ with its fast-mode overrides, every oracle armed
+scenarios:
+	JAX_PLATFORMS=cpu $(PY) tools/scenario_lab.py --fast
 
 # faultpoint × {error,delay} matrix against an in-proc cluster; exits
 # nonzero if any injected fault hangs the daemon or breaks recovery

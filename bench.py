@@ -2160,6 +2160,102 @@ def _count_error_rows(outs) -> int:
     return n
 
 
+def _scenario_ab(inst, reqs, pairs=9, reps=150) -> dict:
+    """ISSUE 16 acceptance: the scenario lab's only service-path cost
+    is its JudgeTap — ``observe()`` is an O(1) retain under a lock;
+    digesting/ledgers are deferred to settle-time ``finalize()``.
+    Measured as interleaved pairs of the same object-lane call with
+    the tap *on* (call + observe) and *off* (plain call), alternating
+    order per pair, < 3% budget.  Two departures from the
+    ``_tenant_ab``/``_tracing_ab`` template, both noise armor: the
+    instance under the A/B runs the synchronous OracleEngine lane
+    (the oracle call is strictly FASTER than the real service call,
+    so a tap cost measured as a fraction of it is an UPPER bound on
+    the true service-path overhead), and the estimator is the floor
+    ratio — best rate per side across all pairs — because host noise
+    is one-sided (a spike only ever slows a sample) while a real
+    systematic tap cost slows EVERY sample, the floor included."""
+    from gubernator_tpu.scenarios import NOW0 as S_NOW0
+    from gubernator_tpu.scenarios import JudgeTap
+
+    def _measure(which):
+        judge = JudgeTap(delim="/")
+        t0 = time.perf_counter()
+        for r in range(reps):
+            resps = inst.get_rate_limits(reqs, now_ms=S_NOW0 + r)
+            if which == "on":
+                judge.observe(reqs, resps, S_NOW0 + r)
+        return reps / (time.perf_counter() - t0)
+
+    try:
+        r_on, r_off = [], []
+        for pair in range(pairs + 1):
+            order = ("off", "on") if pair % 2 else ("on", "off")
+            got = {w: _measure(w) for w in order}
+            if pair == 0:
+                continue  # warmup pair, untimed
+            r_on.append(got["on"])
+            r_off.append(got["off"])
+        overhead = (max(r_off) / max(r_on) - 1) * 100
+        row = {"overhead_pct": round(overhead, 2),
+               "overhead_ok": bool(overhead < 3.0),
+               "on_calls_per_s": round(max(r_on), 1),
+               "off_calls_per_s": round(max(r_off), 1),
+               "pairs": pairs, "reps": reps, "rows": len(reqs)}
+        if not row["overhead_ok"]:
+            row["warning"] = ("judge tap measured above its <3% budget "
+                              "on this run; single-host noise — re-run "
+                              "before acting on it")
+        return row
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+
+
+def _sec_scenarios():
+    """Scenario lab (ISSUE 16): run the committed spec library in fast
+    mode — every stack class, every oracle — and record per-scenario
+    verdicts plus the judge-tap service-path A/B.  A scenario added to
+    ``scenarios/`` shows up in the next BENCH round (and ``make
+    bench-diff``) with no extra wiring."""
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.scenarios import load_library, run_scenarios
+    from gubernator_tpu.types import RateLimitRequest
+
+    doc = run_scenarios(load_library(), fast=True)
+    cells = {}
+    for name, r in doc["scenarios"].items():
+        cell = {"ok": r["ok"], "stack": r["stack"],
+                "requests": r["requests"],
+                "admitted_hits": r["admitted_hits"],
+                "over_limit": r["over_limit"],
+                "error_rows": r["error_rows"],
+                "decision_digest": r["decision_digest"][:16],
+                "oracle_ok": {k: v["ok"]
+                              for k, v in r["oracles"].items()}}
+        if "jain_index" in r:
+            cell["jain_index"] = r["jain_index"]
+        cells[name] = cell
+    row = {"count": doc["count"], "all_ok": doc["all_ok"],
+           "scenarios": cells}
+    from gubernator_tpu.oracle import OracleEngine
+    inst = V1Instance(Config(cache_size=1 << 12, sweep_interval_ms=0),
+                      engine=OracleEngine())
+    try:
+        rng = np.random.default_rng(11)
+        reqs = [RateLimitRequest(name="scnab", unique_key=f"k{int(k)}",
+                                 hits=1, limit=10 ** 6,
+                                 duration=86_400_000)
+                for k in rng.integers(0, 64, size=128)]
+        inst.get_rate_limits(reqs, now_ms=NOW0)  # warm the wave path
+        row["runner_ab"] = _scenario_ab(
+            inst, reqs, pairs=3 if FAST else 9,
+            reps=20 if FAST else 150)
+    finally:
+        inst.close()
+    return {"15_scenarios": row}
+
+
 #: section name → (callable, result row keys for skip/error reporting)
 _SECTIONS = {
     "lat_client": (_sec_lat_client,
@@ -2175,11 +2271,12 @@ _SECTIONS = {
     "pallas": (_sec_pallas, ["11_pallas_serving"]),
     "mesh": (_sec_mesh, ["12_mesh_global"]),
     "tiered": (_sec_tiered, ["13_tiered_store"]),
+    "scenarios": (_sec_scenarios, ["15_scenarios"]),
 }
 
 #: device sections that each pay a fresh compile, in run order
 _SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot",
-                  "cfg5", "pallas", "mesh", "tiered"]
+                  "cfg5", "pallas", "mesh", "tiered", "scenarios"]
 
 _WEDGED = False  # set when a section timeout + failed device probe
 #: parent's backend, captured BEFORE the device client is released —

@@ -109,6 +109,9 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_PROBES": "device step: open-addressing probe count (core/step.py)",
     "GUBER_PROFILE_DIR": "on-demand device-profiler capture directory",
     "GUBER_RESULT_TIMEOUT_S": "caller wave-result timeout seconds (finite, > 0)",
+    "GUBER_SCENARIO_DIR": "scenario-lab spec library directory (default scenarios/)",
+    "GUBER_SCENARIO_FAST": "1 forces fast mode in every scenario-lab entry point",
+    "GUBER_SCENARIO_SEED": "overrides every scenario spec's seed (sweep knob)",
     "GUBER_SESSION_BENCH_TIMEOUT": "tools/tpu_session: bench stage timeout seconds",
     "GUBER_SESSION_EXTRAS_OUT": "tools/tpu_session: extras checkpoint JSON path",
     "GUBER_SKETCH_WIDTH": "heavy-hitter sketch counter width (default 4×TOPK)",

@@ -116,6 +116,10 @@ class Metrics:
             "ISSUE 14); any growth after warmup is a retrace bug — "
             "a call site is recompiling the serving program",
             ["fn"], registry=r)
+        self.scenario_runs = Counter(
+            "gubernator_scenario_runs",
+            "scenario-lab runs by verdict (scenarios.py, ISSUE 16)",
+            ["verdict"], registry=r)
         # Dispatcher wave telemetry (ISSUE 1): the wave/queue/compile
         # layer is the hot path and was previously unobservable — a
         # 250-305 s cold compile surfaced only as an empty TimeoutError

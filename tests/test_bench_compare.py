@@ -1,0 +1,102 @@
+"""The bench-diff gate's scenario rules (ISSUE 16): the 15_scenarios
+row surfaces per-scenario verdict bools and Jain's fairness indexes to
+``tools/bench_compare.py``, which must flag a verdict flip or a
+fairness drift beyond the absolute tolerance by scenario NAME — and
+stay quiet inside the band.
+"""
+import copy
+
+from tools.bench_compare import JAIN_TOL, _numeric_metrics, compare
+
+
+def _scen_row(jain=0.20, ok=True, cons_ok=True):
+    return {
+        "count": 2, "all_ok": ok and cons_ok,
+        "runner_ab": {"overhead_pct": 0.4, "overhead_ok": True},
+        "scenarios": {
+            "tenant_abuse_9010": {
+                "ok": ok, "stack": "object", "requests": 200,
+                "error_rows": 0, "decision_digest": "ab" * 8,
+                "oracle_ok": {"fairness": ok, "parity": True},
+                "jain_index": jain},
+            "partition_reconcile": {
+                "ok": cons_ok, "stack": "clustered", "requests": 100,
+                "error_rows": 0, "decision_digest": "cd" * 8,
+                "oracle_ok": {"conservation": cons_ok}}}}
+
+
+def _rows(**kw):
+    return {"15_scenarios": _scen_row(**kw)}
+
+
+def test_numeric_metrics_surfaces_scenario_cells():
+    m = _numeric_metrics(_scen_row(), "15_scenarios")
+    assert m["scenarios.tenant_abuse_9010.ok"] is True
+    assert m["scenarios.tenant_abuse_9010.oracle_ok.fairness"] is True
+    assert m["scenarios.tenant_abuse_9010.jain_index"] == 0.20
+    assert m["scenarios.partition_reconcile.oracle_ok.conservation"] \
+        is True
+    assert m["all_ok"] is True
+    # per-scenario keys only appear for the scenarios row
+    plain = _numeric_metrics(_scen_row(), "6_service_path")
+    assert not any(k.startswith("scenarios.") for k in plain)
+
+
+def test_verdict_flip_is_a_regression_by_name():
+    verdict = compare(_rows(), _rows(ok=False))
+    names = {r["metric"] for r in verdict["regressions"]}
+    assert "scenarios.tenant_abuse_9010.ok" in names
+    assert "scenarios.tenant_abuse_9010.oracle_ok.fairness" in names
+    assert "all_ok" in names
+    # the untouched scenario stays clean
+    assert not any("partition_reconcile" in n for n in names)
+
+
+def test_oracle_flip_alone_is_caught():
+    verdict = compare(_rows(), _rows(cons_ok=False))
+    names = {r["metric"] for r in verdict["regressions"]}
+    assert "scenarios.partition_reconcile.oracle_ok.conservation" \
+        in names
+
+
+def test_false_to_true_is_not_a_regression():
+    verdict = compare(_rows(ok=False), _rows(ok=True))
+    assert verdict["regressions"] == []
+
+
+def test_jain_drift_beyond_tolerance_regresses_both_directions():
+    for new in (0.20 + JAIN_TOL + 0.01, 0.20 - JAIN_TOL - 0.01):
+        verdict = compare(_rows(jain=0.20), _rows(jain=new))
+        hits = [r for r in verdict["regressions"]
+                if r["metric"] == "scenarios.tenant_abuse_9010"
+                                  ".jain_index"]
+        assert len(hits) == 1, (new, verdict["regressions"])
+        assert hits[0]["tolerance"] == JAIN_TOL
+        assert "fairness" in hits[0]["why"]
+
+
+def test_jain_drift_within_tolerance_passes():
+    for new in (0.20 + JAIN_TOL - 0.01, 0.20 - JAIN_TOL + 0.01, 0.20):
+        verdict = compare(_rows(jain=0.20), _rows(jain=new))
+        assert verdict["regressions"] == [], new
+
+
+def test_scenario_added_or_removed_is_not_compared():
+    """A new scenario in the library (or one retired) has no
+    counterpart — the gate diffs the intersection only."""
+    old = _rows()
+    new = copy.deepcopy(old)
+    cell = new["15_scenarios"]["scenarios"].pop("partition_reconcile")
+    new["15_scenarios"]["scenarios"]["fresh_spec"] = cell
+    verdict = compare(old, new)
+    assert verdict["regressions"] == []
+    assert verdict["compared_metrics"] > 0
+
+
+def test_skipped_row_shortcircuits_scenarios_too():
+    old, new = _rows(), _rows(ok=False)
+    new["15_scenarios"]["context"] = "host was swapping"
+    verdict = compare(old, new)
+    assert verdict["regressions"] == []
+    assert verdict["skipped_rows"] == [
+        {"row": "15_scenarios", "reason": "context"}]

@@ -195,6 +195,80 @@ def test_memledger_ab_block_schema():
         inst.close()
 
 
+def test_scenarios_section_child_writes_row(tmp_path):
+    """The 15_scenarios row (ISSUE 16) through the driver's real child
+    protocol: the whole committed spec library runs fast-mode with
+    every oracle armed, and the row pins per-scenario verdicts (the
+    bench-diff gate compares them by name) plus the judge-tap
+    service-path A/B.
+
+    The library's every-oracle verdicts are pinned individually (and
+    strictly) by tests/test_scenarios.py; this test pins the child
+    protocol and the row schema.  Because the child spins five real
+    stack assemblies back-to-back, a loaded tier-1 host can starve a
+    cluster's settle window — so a run that isn't all_ok gets ONE
+    retry, and only a repeatable failure fails the build."""
+    rows = _run_section("scenarios", tmp_path, timeout=600)
+    r = rows["15_scenarios"]
+    if not r["all_ok"]:
+        rows = _run_section("scenarios", tmp_path, timeout=600)
+        r = rows["15_scenarios"]
+    assert r["count"] >= 7
+    assert r["all_ok"] is True, {
+        n: c for n, c in r["scenarios"].items() if not c["ok"]}
+    assert len(r["scenarios"]) == r["count"]
+    stacks = set()
+    for name, cell in r["scenarios"].items():
+        assert cell["ok"] is True, (name, cell)
+        assert cell["error_rows"] == 0, (name, cell)
+        assert cell["requests"] > 0
+        assert len(cell["decision_digest"]) == 16
+        assert cell["oracle_ok"] and all(
+            isinstance(v, bool) for v in cell["oracle_ok"].values())
+        stacks.add(cell["stack"])
+    assert {"object", "wire", "clustered", "mesh", "tiered"} <= stacks
+    ji = r["scenarios"]["tenant_abuse_9010"]["jain_index"]
+    assert 0.0 < ji < 1.0
+    ab = r["runner_ab"]
+    assert "error" not in ab, ab
+    for k in ("overhead_pct", "overhead_ok", "on_calls_per_s",
+              "off_calls_per_s", "pairs", "reps", "rows"):
+        assert k in ab, (k, ab)
+    assert isinstance(ab["overhead_ok"], bool)
+
+
+def test_scenario_ab_block_schema():
+    """The 15_scenarios ``runner_ab`` block run directly on a small
+    instance: schema + the JudgeTap's O(1) observe discipline (all
+    per-row attribution deferred to finalize), same A/B pattern as
+    ``memledger_ab``."""
+    sys.path.insert(0, REPO)
+    import bench
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.oracle import OracleEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    inst = V1Instance(Config(cache_size=1 << 10, sweep_interval_ms=0),
+                      engine=OracleEngine())
+    try:
+        reqs = [RateLimitRequest(name="ab", unique_key=f"k{i}", hits=1,
+                                 limit=1000, duration=60_000)
+                for i in range(8)]
+        row = bench._scenario_ab(inst, reqs, pairs=2, reps=4)
+        assert "error" not in row, row
+        for k in ("overhead_pct", "overhead_ok", "on_calls_per_s",
+                  "off_calls_per_s", "pairs", "reps", "rows"):
+            assert k in row, (k, row)
+        assert isinstance(row["overhead_ok"], bool)
+        assert row["on_calls_per_s"] > 0
+        assert row["off_calls_per_s"] > 0
+        assert row["pairs"] == 2 and row["reps"] == 4
+        assert row["rows"] == 8
+    finally:
+        inst.close()
+
+
 def test_section_registry_covers_baseline_rows():
     """Every BASELINE row key the orchestrator may need to error-fill
     is declared by exactly one section."""
@@ -208,7 +282,7 @@ def test_section_registry_covers_baseline_rows():
                 "6_service_path", "7_hot_psum", "8_peer_path",
                 "9_clustered_service", "10_reuseport_group",
                 "11_pallas_serving", "12_mesh_global",
-                "13_tiered_store"]:
+                "13_tiered_store", "15_scenarios"]:
         assert row in declared, row
     for name in bench._SECTION_ORDER:
         assert name in bench._SECTIONS

@@ -3,6 +3,7 @@ membership churn (stateful handover on), asserting global conservation
 and zero unexpected errors — the scaled-up analog of the reference's
 functional suite driving real daemons over loopback gRPC."""
 import threading
+import time
 
 import numpy as np
 
@@ -14,6 +15,30 @@ from gubernator_tpu.parallel import make_mesh
 from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
 
 NOW = 1_778_000_000_000
+
+
+def assert_pool_drained(cluster, n, deadline_s=10.0):
+    """ISSUE 2 invariant, load-tolerant form (deflaked in ISSUE 16):
+    ``leaks`` is zero-tolerance immediately — a leaked lease regrows
+    the per-wave allocations the pool exists to remove.  ``outstanding``
+    is different: the last client call returning does not mean the last
+    wave landed (an async GLOBAL flush retrying through a
+    DEADLINE_EXCEEDED can hold its lease for a beat), so it gets a
+    drain window instead of an instant assert."""
+    pools = [p for i in range(n)
+             if (p := getattr(cluster.instance_at(i).engine,
+                              "wave_pool", None)) is not None]
+    for pool in pools:
+        assert pool.stats()["leaks"] == 0, pool.stats()
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        snaps = [p.stats() for p in pools]
+        if all(s["outstanding"] == 0 for s in snaps):
+            break
+        time.sleep(0.05)
+    for pool in pools:
+        s = pool.stats()
+        assert s["leaks"] == 0 and s["outstanding"] == 0, s
 
 
 def cfgs(n, handover=True):
@@ -99,15 +124,7 @@ def test_soak_mixed_traffic_with_churn():
         # a time; churn may re-home it (reset or handover), so admitted
         # lies in [LIMIT, 2×LIMIT] — never more than one extra bucket.
         assert LIMIT <= admitted["strict"] <= 2 * LIMIT, admitted
-        # ISSUE 2: wave buffer-pool leases must come back on EVERY
-        # path (engine raise, timeout, close) — zero tolerance, a leak
-        # regrows the per-wave allocations the pool exists to remove
-        for i in range(2):
-            pool = getattr(cluster.instance_at(i).engine, "wave_pool",
-                           None)
-            if pool is not None:
-                s = pool.stats()
-                assert s["leaks"] == 0 and s["outstanding"] == 0, s
+        assert_pool_drained(cluster, 2)
     finally:
         cluster.stop()
 
@@ -185,14 +202,6 @@ def test_soak_pallas_serving_mode_with_churn(monkeypatch):
         # capacity 60; churn may re-home the key once (reset or
         # handover) so admitted lies in [LIMIT, 2*LIMIT]
         assert LIMIT <= admitted["strict"] <= 2 * LIMIT, admitted
-        # ISSUE 2: wave buffer-pool leases must come back on EVERY
-        # path (engine raise, timeout, close) — zero tolerance, a leak
-        # regrows the per-wave allocations the pool exists to remove
-        for i in range(2):
-            pool = getattr(cluster.instance_at(i).engine, "wave_pool",
-                           None)
-            if pool is not None:
-                s = pool.stats()
-                assert s["leaks"] == 0 and s["outstanding"] == 0, s
+        assert_pool_drained(cluster, 2)
     finally:
         cluster.stop()

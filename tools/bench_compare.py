@@ -32,6 +32,12 @@ import sys
 #: run-to-run spread at ~±15% on the shared build host)
 THROUGHPUT_TOL = 0.30  # *_per_s: lower is worse
 LATENCY_TOL = 0.50     # *_ms: higher is worse
+#: Jain's fairness index is seeded and deterministic per scenario, but
+#: admission boundaries can shift a little when a scenario spec's
+#: volume knobs are retuned — compare with an ABSOLUTE band, both
+#: directions (a fairness metric drifting either way means the
+#: scenario changed character, not just got slower)
+JAIN_TOL = 0.05
 
 #: keys that flag a row as environment-dominated (the run said so)
 _SKIP_KEYS = ("context", "error")
@@ -79,9 +85,14 @@ def _row_skip_reason(row: dict):
     return None
 
 
-def _numeric_metrics(row: dict) -> dict:
+def _numeric_metrics(row: dict, row_name=None) -> dict:
     """Scalar comparable metrics of one row (one level deep only —
-    nested A/B blocks carry their own ok-verdicts, compared as bools)."""
+    nested A/B blocks carry their own ok-verdicts, compared as bools).
+    The ``15_scenarios`` row additionally surfaces its per-scenario
+    verdict bools (``scenarios.<name>.ok``, per-oracle ``oracle_ok.*``)
+    and each scenario's Jain's index, so a scenario whose oracles
+    regress — or whose fairness character drifts — fails the gate by
+    name instead of hiding inside an aggregate ``all_ok``."""
     out = {}
     for k, v in row.items():
         if isinstance(v, bool) or isinstance(v, (int, float)):
@@ -90,6 +101,19 @@ def _numeric_metrics(row: dict) -> dict:
             for kk, vv in v.items():
                 if isinstance(vv, bool) and kk.endswith("_ok"):
                     out[f"{k}.{kk}"] = vv
+    if row_name == "15_scenarios":
+        for sname, cell in (row.get("scenarios") or {}).items():
+            if not isinstance(cell, dict):
+                continue
+            if isinstance(cell.get("ok"), bool):
+                out[f"scenarios.{sname}.ok"] = cell["ok"]
+            for orc, vv in (cell.get("oracle_ok") or {}).items():
+                if isinstance(vv, bool):
+                    out[f"scenarios.{sname}.oracle_ok.{orc}"] = vv
+            ji = cell.get("jain_index")
+            if isinstance(ji, (int, float)) \
+                    and not isinstance(ji, bool):
+                out[f"scenarios.{sname}.jain_index"] = ji
     return out
 
 
@@ -112,7 +136,7 @@ def compare(prev_rows: dict, new_rows: dict) -> dict:
         if reason:
             skipped.append({"row": name, "reason": reason})
             continue
-        pm, nm = _numeric_metrics(pr), _numeric_metrics(nr)
+        pm, nm = _numeric_metrics(pr, name), _numeric_metrics(nr, name)
         for key in sorted(set(pm) & set(nm)):
             old, new = pm[key], nm[key]
             if isinstance(old, bool) or isinstance(new, bool):
@@ -122,6 +146,17 @@ def compare(prev_rows: dict, new_rows: dict) -> dict:
                         {"row": name, "metric": key,
                          "old": old, "new": new,
                          "why": "verdict flipped true -> false"})
+                continue
+            if key.rsplit(".", 1)[-1] == "jain_index":
+                compared += 1
+                if abs(new - old) > JAIN_TOL:
+                    regressions.append(
+                        {"row": name, "metric": key, "old": old,
+                         "new": new,
+                         "rel_change": round(new - old, 4),
+                         "tolerance": JAIN_TOL,
+                         "why": "fairness index drifted beyond "
+                                "absolute tolerance"})
                 continue
             sign = _direction(key)
             if sign is None or old == 0:

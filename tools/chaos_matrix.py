@@ -754,6 +754,32 @@ def run_slo_cells(verbose=False) -> list:
     return cells
 
 
+def run_scenario_cells(verbose=False) -> list:
+    """Generated cells from the scenario lab (ISSUE 16): every spec in
+    the committed library runs in fast mode and contributes one cell —
+    its oracle verdicts ARE the cell verdict.  Adding a scenario file
+    grows the chaos matrix with no code here."""
+    from gubernator_tpu import scenarios as scn
+
+    cells = []
+    for spec in scn.load_library():
+        try:
+            row = scn.ScenarioRunner(spec, fast=True).run(fast=True)
+            cell = {"cell": f"scenario:{spec.name}",
+                    "stack": row["stack"], "ok": row["ok"],
+                    "requests": row["requests"],
+                    "error_rows": row["error_rows"],
+                    "oracles": {k: v["ok"]
+                                for k, v in row["oracles"].items()}}
+        except Exception as e:  # noqa: BLE001 - recorded verdict
+            cell = {"cell": f"scenario:{spec.name}", "ok": False,
+                    "error": (str(e) or repr(e))[:200]}
+        cells.append(cell)
+        if verbose:
+            print(json.dumps(cell), file=sys.stderr)
+    return cells
+
+
 def run_matrix(points=None, verbose=False) -> dict:
     from gubernator_tpu.faults import FAULT_POINTS, FaultInjected
 
@@ -804,21 +830,28 @@ def run_matrix(points=None, verbose=False) -> dict:
                     print(json.dumps(cell), file=sys.stderr)
     finally:
         ctx.close()
-    # SLO breach→recover cells ride the FULL matrix only (`make
-    # chaos`): a --point / smoke subset stays fast
+    # SLO breach→recover cells and generated scenario cells ride the
+    # FULL matrix only (`make chaos`): a --point / smoke subset stays
+    # fast
     slo_cells = run_slo_cells(verbose=verbose) if not points else []
+    scenario_cells = (run_scenario_cells(verbose=verbose)
+                      if not points else [])
     exercised = [c for c in cells if c["outcome"] != "not_reached"]
     return {
         "cells": cells,
         "slo_cells": slo_cells,
+        "scenario_cells": scenario_cells,
         "exercised": len(exercised),
         "not_reached": [f"{c['point']}:{c['mode']}" for c in cells
                         if c["outcome"] == "not_reached"],
         "failed": ([f"{c['point']}:{c['mode']}" for c in cells
                     if not c["ok"]]
-                   + [c["cell"] for c in slo_cells if not c["ok"]]),
+                   + [c["cell"] for c in slo_cells if not c["ok"]]
+                   + [c["cell"] for c in scenario_cells
+                      if not c["ok"]]),
         "ok": (all(c["ok"] for c in cells)
-               and all(c["ok"] for c in slo_cells)),
+               and all(c["ok"] for c in slo_cells)
+               and all(c["ok"] for c in scenario_cells)),
     }
 
 

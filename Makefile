@@ -3,8 +3,8 @@
 PY ?= python
 
 .PHONY: test proto bench bench-pallas bench-tiered bench-diff chaos \
-        scenarios tpu-session b-sweep daemon cluster lint native tsan \
-        asan racer check clean
+        scenarios fleet-audit tpu-session b-sweep daemon cluster lint \
+        native tsan asan racer check clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -42,7 +42,7 @@ racer:
 # CI-style gate: static analysis + sanitizer soaks + the concurrency
 # test subset + the compile-ledger gate (steady-state zero recompiles
 # on the service path); the full tier-1 battery stays `make test`
-check: lint tsan asan scenarios
+check: lint tsan asan scenarios fleet-audit
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_guberlint.py \
 	    tests/test_lint_clean.py tests/test_compileledger.py \
 	    tests/test_created_at.py \
@@ -59,6 +59,12 @@ scenarios:
 # nonzero if any injected fault hangs the daemon or breaks recovery
 chaos:
 	$(PY) tools/chaos_matrix.py
+
+# 3-daemon fleet conservation smoke (ISSUE 19, fleet.py): drive GLOBAL
+# traffic, then fold every daemon's OWN GET /debug/audit vector and
+# prove fleet drift == 0 at steady state with a consistent ring
+fleet-audit:
+	JAX_PLATFORMS=cpu $(PY) tools/fleet_audit_smoke.py
 
 proto:
 	cd gubernator_tpu/proto && protoc -I. --python_out=. \

@@ -2256,6 +2256,140 @@ def _sec_scenarios():
     return {"15_scenarios": row}
 
 
+def _audit_ab(inst, datas, pairs=9, reps=60) -> dict:
+    """ISSUE 19 acceptance: the conservation audit tap must cost < 1%
+    on the service path.  Interleaved pairs of the same GLOBAL wire
+    call with the tap attached (``gm.audit`` is an AuditTap) and
+    detached (None darkens every tap site), alternating order per
+    pair, floor-ratio estimator (the ``_scenario_ab`` noise armor —
+    the budget is tight enough that median-of-ratios jitter on a
+    shared host would dominate the verdict)."""
+    from gubernator_tpu.fleet import AuditTap
+
+    gm = inst._ensure_global_manager()
+    old = gm.audit
+
+    def _measure(which):
+        gm.audit = AuditTap() if which == "on" else None
+        t0 = time.perf_counter()
+        for r in range(reps):
+            inst.get_rate_limits_wire(datas[r % len(datas)],
+                                      now_ms=NOW0 + r)
+        return reps / (time.perf_counter() - t0)
+
+    try:
+        r_on, r_off = [], []
+        for pair in range(pairs + 1):
+            order = ("off", "on") if pair % 2 else ("on", "off")
+            got = {w: _measure(w) for w in order}
+            if pair == 0:
+                continue  # warmup pair, untimed
+            r_on.append(got["on"])
+            r_off.append(got["off"])
+        overhead = (max(r_off) / max(r_on) - 1) * 100
+        row = {"overhead_pct": round(overhead, 2),
+               "overhead_ok": bool(overhead < 1.0),
+               "on_calls_per_s": round(max(r_on), 1),
+               "off_calls_per_s": round(max(r_off), 1),
+               "pairs": pairs, "reps": reps}
+        if not row["overhead_ok"]:
+            row["warning"] = ("audit tap measured above its <1% budget "
+                              "on this run; single-host noise — re-run "
+                              "before acting on it")
+        return row
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        return {"error": (str(e) or repr(e))[:200]}
+    finally:
+        gm.audit = old
+
+
+def _sec_fleet():
+    """Fleet watchtower (ISSUE 19): the audit-tap A/B on the service
+    path (< 1% budget) plus the fleet-merge wall time at 3 daemons —
+    fetch every daemon's debug endpoints over HTTP and time ONLY the
+    exact folds (fleet.py), the cost a control plane's fleet tick
+    would pay per sweep."""
+    import urllib.request
+
+    from gubernator_tpu import cluster as cluster_mod
+    from gubernator_tpu import fleet
+    from gubernator_tpu.config import BehaviorConfig, Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.types import Behavior, RateLimitRequest
+
+    row = {}
+    rng = np.random.default_rng(7)
+    reqs = [[RateLimitRequest(name="fleetab", unique_key=f"k{int(k)}",
+                              hits=1, limit=10 ** 6, duration=86_400_000,
+                              behavior=Behavior.GLOBAL)
+             for k in rng.zipf(ZIPF_A, size=1000) % 100_000]
+            for _ in range(4)]
+    datas = _serialize_reqs(reqs)
+    inst = V1Instance(Config(cache_size=1 << 15, sweep_interval_ms=0))
+    try:
+        inst.get_rate_limits_wire(datas[0], now_ms=NOW0)  # warm
+        row["audit_ab"] = _audit_ab(
+            inst, datas, pairs=3 if FAST else 9,
+            reps=10 if FAST else 60)
+    finally:
+        inst.close()
+
+    c = cluster_mod.start(3, behaviors=BehaviorConfig(
+        global_sync_wait_ms=50), cache_size=1 << 12)
+    try:
+        for i in range(3):
+            ci = c.instance_at(i)
+            ci.get_rate_limits(
+                [RateLimitRequest(name="fleet", unique_key=f"m{j}",
+                                  hits=1, limit=10 ** 6, duration=86_400_000,
+                                  behavior=Behavior.GLOBAL)
+                 for j in range(64)], now_ms=NOW0)
+            ana = ci.analytics
+            if ana is not None:
+                ana.flush(timeout=5.0)
+        # settle the flush discipline so the timed merge measures a
+        # conserved steady state, not a mid-flush snapshot
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            insts = [c.instance_at(i) for i in range(3)]
+            for ci in insts:
+                if ci.global_manager is not None:
+                    ci.global_manager.poke()
+            time.sleep(0.1)
+            if all(ci.audit_doc()["conserved"] for ci in insts):
+                break
+
+        def fetch(path):
+            docs = []
+            for i in range(3):
+                url = c.http_address(i) + path
+                with urllib.request.urlopen(url, timeout=5.0) as f:
+                    docs.append(json.loads(f.read()))
+            return docs
+
+        raw = {p: fetch(p) for p in ("/debug/audit", "/debug/topkeys",
+                                     "/debug/tenants", "/debug/slo",
+                                     "/debug/memory")}
+        t0 = time.perf_counter()
+        fold = fleet.fold_audits(raw["/debug/audit"])
+        fleet.ring_verdict(raw["/debug/audit"])
+        fleet.merge_topkeys(raw["/debug/topkeys"])
+        tns = fleet.merge_tenants(raw["/debug/tenants"])
+        fleet.merge_slo(raw["/debug/slo"])
+        fleet.merge_memory(raw["/debug/memory"])
+        wall = (time.perf_counter() - t0) * 1000
+        row["fleet_merge_wall_ms"] = round(wall, 3)
+        row["merge"] = {"daemons": 3,
+                        "drift": fold["drift"],
+                        "conserved_ok": bool(fold["conserved"]),
+                        "tenants_sum_ok": bool(tns["conserved"])}
+    except Exception as e:  # noqa: BLE001 - diagnostics only
+        row["merge"] = {"error": (str(e) or repr(e))[:200]}
+    finally:
+        c.stop()
+    return {"16_fleet": row}
+
+
 #: section name → (callable, result row keys for skip/error reporting)
 _SECTIONS = {
     "lat_client": (_sec_lat_client,
@@ -2272,11 +2406,13 @@ _SECTIONS = {
     "mesh": (_sec_mesh, ["12_mesh_global"]),
     "tiered": (_sec_tiered, ["13_tiered_store"]),
     "scenarios": (_sec_scenarios, ["15_scenarios"]),
+    "fleet": (_sec_fleet, ["16_fleet"]),
 }
 
 #: device sections that each pay a fresh compile, in run order
 _SECTION_ORDER = ["cfg12", "cfg4", "svc", "cluster", "group", "hot",
-                  "cfg5", "pallas", "mesh", "tiered", "scenarios"]
+                  "cfg5", "pallas", "mesh", "tiered", "scenarios",
+                  "fleet"]
 
 _WEDGED = False  # set when a section timeout + failed device probe
 #: parent's backend, captured BEFORE the device client is released —

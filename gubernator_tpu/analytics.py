@@ -36,6 +36,7 @@ caller.
 """
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -353,6 +354,126 @@ class HeavyHitterSketch:
                         "over_limit": int(self._over[s]),
                         "last_seen_ms": int(self._last[s])})
         return out
+
+    # ---- fleet merge surface (ISSUE 19) ---------------------------------
+
+    def merge_entries(self, entries: List[dict],
+                      total_weight: Optional[int] = None) -> None:
+        """Fold another sketch's REPORTED rows (``topk()`` dicts, khash
+        as int or ``0x…`` hex) into this one — the fleet watchtower's
+        merge surface.  Reuses the exact two-way Space-Saving merge:
+        tracked keys add counts AND error bounds; untracked keys fill
+        free slots (keeping their remote ``err``) or run
+        ``_admit_merge``, after which the remote ``err`` of each
+        SURVIVING newcomer is added on top of the inherited eviction
+        bound.  The merged sketch obeys the summed-stream guarantee:
+        ``true <= count`` and ``count - true <= err`` against the union
+        stream.  When both sides saw disjoint key sets that fit in
+        ``width`` the merge is exact (all ``err`` unchanged), which is
+        what the fleet byte-equality test pins."""
+        rows = []
+        for e in entries:
+            kh = e.get("khash")
+            if isinstance(kh, str):
+                kh = int(kh, 16)
+            hits = int(e.get("hits", 0))
+            if hits <= 0:
+                continue
+            rows.append((int(kh), hits, int(e.get("err", 0)),
+                         int(e.get("over_limit", 0)),
+                         int(e.get("last_seen_ms", 0)),
+                         e.get("key")))
+        if total_weight is not None:
+            self.total_weight += int(total_weight)
+        elif rows:
+            self.total_weight += sum(r[1] for r in rows)
+        if not rows:
+            return
+        kh = np.array([r[0] for r in rows], np.uint64)
+        w = np.array([r[1] for r in rows], np.int64)
+        er = np.array([r[2] for r in rows], np.int64)
+        ov = np.array([r[3] for r in rows], np.int64)
+        ls = np.array([r[4] for r in rows], np.int64)
+        for r in rows:
+            if r[5] is not None:
+                self._note_name(r[0], r[5])
+        # aggregate duplicate khashes (defensive: topk() never repeats
+        # a hash, but merged docs from a retrying fetcher might)
+        sort = np.argsort(kh, kind="stable")
+        ks = kh[sort]
+        starts = np.nonzero(np.concatenate(
+            ([True], ks[1:] != ks[:-1])))[0]
+        uniq = ks[starts]
+        wsum = np.add.reduceat(w[sort], starts)
+        ersum = np.add.reduceat(er[sort], starts)
+        ovsum = np.add.reduceat(ov[sort], starts)
+        lsmax = np.maximum.reduceat(ls[sort], starts)
+        # tracked probe: counts add, error bounds add (both remotes'
+        # overestimates can stack on the same key)
+        self._reindex()
+        if self._sorted_kh.size:
+            pos = np.minimum(np.searchsorted(self._sorted_kh, uniq),
+                             self._sorted_kh.size - 1)
+            tracked = self._sorted_kh[pos] == uniq
+            slots = self._sorted_slot[pos[tracked]]
+            self._cnt[slots] += wsum[tracked]
+            self._err[slots] += ersum[tracked]
+            self._over[slots] += ovsum[tracked]
+            np.maximum.at(self._last, slots, lsmax[tracked])
+        else:
+            tracked = np.zeros(uniq.size, bool)
+        if int(tracked.sum()) == uniq.size:
+            return
+        new_kh = uniq[~tracked]
+        new_w = wsum[~tracked]
+        new_er = ersum[~tracked]
+        new_o = ovsum[~tracked]
+        new_ls = lsmax[~tracked]
+        free = self.width - self._used
+        if free > 0:
+            take = min(free, len(new_kh))
+            sl = np.arange(self._used, self._used + take)
+            self._kh[sl] = new_kh[:take]
+            self._cnt[sl] = new_w[:take]
+            self._err[sl] = new_er[:take]  # keep the remote bound
+            self._over[sl] = new_o[:take]
+            self._last[sl] = new_ls[:take]
+            self._used += take
+            self._dirty = True
+            if take == len(new_kh):
+                return
+            new_kh, new_w, new_er, new_o, new_ls = (
+                new_kh[take:], new_w[take:], new_er[take:],
+                new_o[take:], new_ls[take:])
+        t_ms = int(new_ls.max())
+        self._admit_merge(new_kh, new_w, new_o, t_ms)
+        # surviving newcomers inherited an eviction bound from
+        # _admit_merge; their remote err stacks on top (the remote
+        # count they brought was itself an overestimate)
+        self._reindex()
+        pos = np.minimum(np.searchsorted(self._sorted_kh, new_kh),
+                         self._sorted_kh.size - 1)
+        alive = self._sorted_kh[pos] == new_kh
+        slots = self._sorted_slot[pos[alive]]
+        self._err[slots] += new_er[alive]
+        np.maximum.at(self._last, slots, new_ls[alive])
+
+    def canonical_bytes(self) -> bytes:
+        """Deterministic byte form of the tracked state — khash-sorted
+        ``(khash, cnt, err, over)`` rows as JSON.  ``last_seen_ms`` is
+        a wall-clock artifact, not sketch state, so it is excluded;
+        two sketches that tracked the same multiset of decisions
+        byte-equal regardless of when they saw them (the fleet
+        merge-exactness pin in tests/test_fleet.py)."""
+        u = self._used
+        rows = sorted(zip(self._kh[:u].tolist(),
+                          self._cnt[:u].tolist(),
+                          self._err[:u].tolist(),
+                          self._over[:u].tolist()))
+        return json.dumps({"width": self.width, "k": self.k,
+                           "total_weight": self.total_weight,
+                           "rows": rows},
+                          separators=(",", ":")).encode()
 
 
 class PhaseLedger:

@@ -8,6 +8,12 @@ Usage: python -m gubernator_tpu.cmd.cli --address host:port
 Debug subcommand (the flight-recorder round trip, OBSERVABILITY.md):
        python -m gubernator_tpu.cmd.cli debug events
        [--url http://host:port] [--limit N] [--json] [--kind K]
+
+Fleet subcommand (ISSUE 19, OBSERVABILITY.md › Fleet plane): fan in
+every daemon's debug endpoints and fold them exactly (fleet.py):
+       python -m gubernator_tpu.cmd.cli fleet
+       {status,audit,topkeys,tenants,slo,memory}
+       --url http://d1:1050 --url http://d2:1050 ...
 """
 from __future__ import annotations
 
@@ -408,10 +414,191 @@ def _debug_faults(args) -> int:
     return 0
 
 
+def _fleet_main(argv) -> int:
+    """``fleet {status,audit,topkeys,tenants,slo,memory}``: fetch the
+    matching /debug endpoint from EVERY --url daemon and fold the
+    documents through fleet.py's exact merges.  Exit 1 on fetch
+    failure or a failed conservation/consistency verdict, so the
+    command doubles as a cluster health probe."""
+    ap = argparse.ArgumentParser(
+        prog="guber-cli fleet",
+        description="cluster-wide folds over every daemon's debug "
+                    "endpoints (fleet.py)")
+    sub = ap.add_subparsers(dest="what", required=True)
+    helps = {
+        "status": "healthz rollup + ring consistency + conservation",
+        "audit": "fold the daemons' conservation audit vectors",
+        "topkeys": "cluster top-K via the exact Space-Saving merge",
+        "tenants": "fleet tenant RED rollup (sum-asserted)",
+        "slo": "fleet SLO burn rollup (worst-of latch + summed burn)",
+        "memory": "fleet memory-ledger pressure",
+    }
+    for what, h in helps.items():
+        p = sub.add_parser(what, help=h)
+        p.add_argument("--url", action="append", dest="urls",
+                       default=None,
+                       help="daemon HTTP base url (repeat per daemon; "
+                            "default http://localhost:1050)")
+        p.add_argument("--timeout", type=float, default=10.0)
+        p.add_argument("--json", action="store_true",
+                       help="print the raw folded JSON document")
+        if what == "topkeys":
+            p.add_argument("--limit", type=int, default=0,
+                           help="only the heaviest N keys")
+    args = ap.parse_args(argv)
+    urls = args.urls or ["http://localhost:1050"]
+    endpoint = {"status": "/healthz", "audit": "/debug/audit",
+                "topkeys": "/debug/topkeys",
+                "tenants": "/debug/tenants", "slo": "/debug/slo",
+                "memory": "/debug/memory"}[args.what]
+
+    def _fan(path):
+        docs = []
+        for base in urls:
+            try:
+                docs.append(_fetch_json(
+                    base.rstrip("/") + path, args.timeout))
+            except Exception as e:  # noqa: BLE001
+                print(f"fetch failed ({base}{path}): {e!r}",
+                      file=sys.stderr)
+                return None
+        return docs
+
+    docs = _fan(endpoint)
+    if docs is None:
+        return 1
+    from .. import fleet
+
+    if args.what == "status":
+        audits = _fan("/debug/audit")
+        body = fleet.merge_status(docs, audits)
+        if args.json:
+            print(json.dumps(body))
+        else:
+            print(f"daemons: {body['healthy']}/{body['daemons']} "
+                  f"healthy  peer_counts={body['peer_counts']}")
+            ring = body.get("ring")
+            if ring:
+                state = ("consistent" if ring["consistent"] else
+                         "DIVERGED(" + ",".join(ring["reasons"]) + ")")
+                print(f"ring: {state}  ejected={ring['ejected']}")
+            cons = body.get("conservation")
+            if cons:
+                print(f"conservation: drift={cons['drift']} "
+                      f"{'OK' if cons['conserved'] else 'DRIFTING'}")
+        ring_ok = (body.get("ring") or {}).get("consistent", True)
+        cons_ok = (body.get("conservation")
+                   or {}).get("conserved", True)
+        return 0 if (body["healthy"] == body["daemons"] and ring_ok
+                     and cons_ok) else 1
+    if args.what == "audit":
+        body = fleet.fold_audits(docs)
+        body["ring"] = fleet.ring_verdict(docs)
+        if args.json:
+            print(json.dumps(body))
+        else:
+            t = body["totals"]
+            print(f"fleet drift: {body['drift']} "
+                  f"({'CONSERVED' if body['conserved'] else 'DRIFT'})"
+                  f"  bound={body['bound_s']}s "
+                  f"staleness<={body['staleness_bound_s']}s")
+            print(f"  injected={t['injected']} applied={t['applied']} "
+                  f"queued={t['queued']} in_flight={t['in_flight']} "
+                  f"lost={t['lost']} deg_pending={t['deg_pending']}")
+            if t["mesh_injected"] or t["mesh_folded"]:
+                print(f"  mesh: injected={t['mesh_injected']} "
+                      f"folded={t['mesh_folded']}")
+            for r in body["per_daemon"]:
+                print(f"  {r['instance'] or '?':<24} "
+                      f"drift={r['drift']:<8} queued={r['queued']:<8} "
+                      f"in_flight={r['in_flight']:<6} "
+                      f"lost={r['lost']:<6} "
+                      f"drain_age={r['drain_age_s']}s")
+            ring = body["ring"]
+            state = ("consistent" if ring["consistent"] else
+                     "DIVERGED(" + ",".join(ring["reasons"]) + ")")
+            print(f"ring: {state} across {ring['daemons']} daemon(s)")
+        return 0 if (body["conserved"]
+                     and body["ring"]["consistent"]) else 1
+    if args.what == "topkeys":
+        body = fleet.merge_topkeys(docs, k=args.limit or None)
+        if args.json:
+            print(json.dumps(body))
+        else:
+            print(f"fleet top-{body['k']} of "
+                  f"~{body['total_hits_observed']} hits across "
+                  f"{body['daemons']} daemon(s) "
+                  f"(admission_err<={body['admission_error_bound']})")
+            for e in body["keys"]:
+                name = e.get("key") or e.get("khash")
+                line = (f"{e.get('hits'):>12}  "
+                        f"over={e.get('over_limit'):<8} "
+                        f"err<={e.get('err'):<6} {name}")
+                if e.get("owner"):
+                    line += f"  owner={e['owner']}"
+                print(line)
+        return 0
+    if args.what == "tenants":
+        body = fleet.merge_tenants(docs)
+        if args.json:
+            print(json.dumps(body))
+        else:
+            print(f"fleet tenants: {body['tenant_count']} across "
+                  f"{body['enabled_daemons']}/{body['daemons']} "
+                  f"daemon(s)  "
+                  f"{'SUM-OK' if body['conserved'] else 'SUM-MISMATCH'}")
+            hdr = ("requests", "hits", "over_limit", "errors",
+                   "degraded", "shed")
+            print(f"{'tenant':<24}"
+                  + "".join(f"{h:>11}" for h in hdr))
+            rows = sorted(body["tenants"].items(),
+                          key=lambda kv: -kv[1].get("requests", 0))
+            for name, c in rows:
+                print(f"{name:<24}"
+                      + "".join(f"{c.get(h, 0):>11}" for h in hdr))
+            tot = body["totals"]
+            print(f"{'TOTAL':<24}"
+                  + "".join(f"{tot.get(h, 0):>11}" for h in hdr))
+        return 0 if body["conserved"] else 1
+    if args.what == "slo":
+        body = fleet.merge_slo(docs)
+        if args.json:
+            print(json.dumps(body))
+        else:
+            print(f"fleet SLOs across {body['daemons']} daemon(s), "
+                  f"{body['ticks']} ticks; "
+                  f"breached: {body['breached'] or 'none'}")
+            for r in body["slos"]:
+                name = r["slo"]
+                if r.get("tenant"):
+                    name += f"[{r['tenant']}]"
+                state = "BREACH" if r["breached"] else "ok"
+                line = (f"  {name:<40} {state:<7} "
+                        f"fast_max={r['fast_burn_max']:<8} "
+                        f"fast_sum={r['fast_burn_sum']:<8}")
+                if r.get("value_max") is not None:
+                    line += (f" value_max={r['value_max']} "
+                             f"target={r.get('target')}")
+                print(line)
+        return 0
+    body = fleet.merge_memory(docs)
+    if args.json:
+        print(json.dumps(body))
+    else:
+        print(f"fleet memory: device={body['device_bytes']} "
+              f"host={body['host_bytes']} "
+              f"max_pressure={body['max_pressure']}")
+        for name, b in sorted(body["consumer_bytes"].items()):
+            print(f"  {name:<14} bytes={b}")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "debug":
         return _debug_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        return _fleet_main(argv[1:])
     ap = argparse.ArgumentParser(description="gubernator-tpu load tester")
     ap.add_argument("--address", default="localhost:1051")
     ap.add_argument("--http", action="store_true",

@@ -68,6 +68,8 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_EXTRAS_SMOKE": "tools/tpu_session: run the extras smoke block",
     "GUBER_FAULT": "fault-injection spec point[@tag]:mode[:arg[:prob]],... (faults.py)",
     "GUBER_FAULT_SEED": "fault-injection RNG seed for bit-for-bit chaos replay",
+    "GUBER_FLEET_AUDIT": "conservation auditor on the GLOBAL lanes: 0 disables the audit taps + /debug/audit drift (default on)",
+    "GUBER_FLEET_DRIFT_BOUND": "conservation drift staleness bound (duration) before the fleet_conservation SLO burns; default 2x GUBER_GLOBAL_SYNC_WAIT",
     "GUBER_GLOBAL_BATCH_LIMIT": "GLOBAL hit-flush batch limit",
     "GUBER_GLOBAL_BROADCAST_INTERVAL": "GLOBAL owner-broadcast tick interval (duration)",
     "GUBER_GLOBAL_MODE": "GLOBAL reconcile backend: grpc (default) or mesh (pod-local collective fold)",

@@ -460,6 +460,17 @@ class Daemon:
                     ana.flush(timeout=2.0)  # fold queued taps first
                     self._send(200, json.dumps(
                         ana.tenants_snapshot()).encode())
+                elif path == "/debug/audit":
+                    # conservation audit vector (fleet.py): per-lane
+                    # injected/applied/queued/in-flight counters, the
+                    # drift they prove, and the ring view the fleet
+                    # fold cross-checks.  Always served — the auditor
+                    # rides the GLOBAL lanes' own accounting (a
+                    # GUBER_FLEET_AUDIT=0 daemon reports enabled=false
+                    # with zeroed lanes rather than 404ing, so a fleet
+                    # fold over a mixed cluster still completes)
+                    self._send(200, json.dumps(
+                        daemon.instance.audit_doc()).encode())
                 elif path == "/debug/slo":
                     # SLO registry + live burn rates (slo.py)
                     if daemon.instance.slo is None:

@@ -72,6 +72,18 @@ class GlobalManager:
         self._hits_raw: Dict[int, Tuple[bytes, int, int]] = {}  # guarded-by: self._mu
         #: key-hash → (seq, request TLV bytes) — wire lane, owner side.
         self._updates_raw: Dict[int, Tuple[int, bytes]] = {}  # guarded-by: self._mu
+        #: degraded share of the queued accumulators, keyed like the
+        #: queues (ISSUE 19): parallel dicts instead of widening the
+        #: queue tuples — external drivers (chaos) unpack 3-tuples
+        self._deg: Dict[str, int] = {}  # guarded-by: self._mu
+        self._deg_raw: Dict[int, int] = {}  # guarded-by: self._mu
+        #: conservation audit tap (ISSUE 19, fleet.py): sender-side
+        #: double-entry ledger behind GET /debug/audit.  Own leaf
+        #: lock; every call sits OUTSIDE self._mu so the tap adds no
+        #: lock-order edge.  None when GUBER_FLEET_AUDIT=0.
+        from .fleet import AuditTap, audit_enabled
+
+        self.audit = AuditTap() if audit_enabled() else None
         self._err_mu = threading.Lock()
         self._last_error = ""  # guarded-by: self._err_mu
         self._last_error_at = 0.0  # guarded-by: self._err_mu
@@ -84,17 +96,23 @@ class GlobalManager:
 
     # ---- producers (called from the request path) ----------------------
 
-    def queue_hits(self, req: RateLimitRequest) -> None:
+    def queue_hits(self, req: RateLimitRequest,
+                   degraded: bool = False) -> None:
         """Accumulate hits for async reconcile to the owner.
-        reference: global.go › QueueHits."""
+        reference: global.go › QueueHits.  ``degraded`` marks hits
+        queued by a degraded-mode serve (ISSUE 19 audit vector)."""
+        inc = max(int(req.hits), 0)
         with self._mu:
             self._seq += 1
             _, acc, _ = self._hits.get(req.key, (req, 0, 0))
-            self._hits[req.key] = (req, acc + max(int(req.hits), 0),
-                                   self._seq)
+            self._hits[req.key] = (req, acc + inc, self._seq)
+            if degraded and inc:
+                self._deg[req.key] = self._deg.get(req.key, 0) + inc
             # both lanes share the flush: threshold and gauge must see
             # the raw queue too or mixed-lane traffic undercounts
             n = len(self._hits) + len(self._hits_raw)
+        if self.audit is not None:
+            self.audit.inject(inc, degraded)
         self.metrics.queue_length.set(n)
         if n >= self.behaviors.global_batch_limit:
             self._hits_loop.poke()
@@ -119,20 +137,26 @@ class GlobalManager:
     # the same flush/broadcast machinery as the object-path queues (so
     # a key served through both lanes merges correctly).
 
-    def queue_hits_raw(self, khash: int, tlv: bytes, hits: int) -> None:
+    def queue_hits_raw(self, khash: int, tlv: bytes, hits: int,
+                       degraded: bool = False) -> None:
         """Wire-lane twin of ``queue_hits``: accumulate ``hits`` for the
         key identified by ``khash``, with ``tlv`` (the verbatim
         GetRateLimitsReq.requests TLV slice) as the deferred prototype.
         A hits=0 entry still refreshes the prototype, exactly as
         queue_hits stores the latest req unconditionally."""
+        inc = max(int(hits), 0)
         with self._mu:
             self._seq += 1
             _, acc, _ = self._hits_raw.get(khash, (tlv, 0, 0))
             # keep the LATEST tlv as the prototype, exactly as
             # queue_hits keeps the latest req: a mid-window config
             # change must reconcile under the new limit/duration
-            self._hits_raw[khash] = (tlv, acc + max(hits, 0), self._seq)
+            self._hits_raw[khash] = (tlv, acc + inc, self._seq)
+            if degraded and inc:
+                self._deg_raw[khash] = self._deg_raw.get(khash, 0) + inc
             n = len(self._hits_raw) + len(self._hits)
+        if self.audit is not None:
+            self.audit.inject(inc, degraded)
         self.metrics.queue_length.set(n)
         if n >= self.behaviors.global_batch_limit:
             self._hits_loop.poke()
@@ -153,25 +177,40 @@ class GlobalManager:
 
         return req_from_tlv(tlv)
 
+    def queued_hits(self) -> Tuple[int, int]:
+        """(total queued hit weight, degraded share) across both
+        lanes — the audit vector's live-queue leg (ISSUE 19)."""
+        with self._mu:
+            q = (sum(a for _, a, _ in self._hits.values())
+                 + sum(a for _, a, _ in self._hits_raw.values()))
+            d = sum(self._deg.values()) + sum(self._deg_raw.values())
+        return q, d
+
     def _requeue_hits(self, entries) -> None:
         """Put a FAILED flush's aggregates back into the queues
         (ISSUE 5): degraded-mode hits reconcile EXACTLY once the owner
         recovers, so an unreachable owner must requeue, not drop.
         ``entries``: (key-or-khash, proto (req object or raw TLV),
-        accumulated hits, seq); merges with anything queued since the
-        flush popped them (latest-prototype-wins, sums preserved)."""
+        accumulated hits, seq, degraded share); merges with anything
+        queued since the flush popped them (latest-prototype-wins,
+        sums preserved).  A requeue is NOT a re-inject — the audit
+        tap saw these hits at queue-entry; they simply stay queued."""
         if not entries:
             return
         with self._mu:
-            for k, proto, acc, seq in entries:
+            for k, proto, acc, seq, deg in entries:
                 if isinstance(proto, bytes):
                     t0, a0, s0 = self._hits_raw.get(k, (proto, 0, 0))
                     self._hits_raw[k] = (proto if seq >= s0 else t0,
                                          a0 + acc, max(s0, seq))
+                    if deg:
+                        self._deg_raw[k] = self._deg_raw.get(k, 0) + deg
                 else:
                     p0, a0, s0 = self._hits.get(k, (proto, 0, 0))
                     self._hits[k] = (proto if seq >= s0 else p0,
                                      a0 + acc, max(s0, seq))
+                    if deg:
+                        self._deg[k] = self._deg.get(k, 0) + deg
             n = len(self._hits) + len(self._hits_raw)
         self.metrics.queue_length.set(n)
 
@@ -243,13 +282,17 @@ class GlobalManager:
         with self._mu:
             hits, self._hits = self._hits, {}
             hits_raw, self._hits_raw = self._hits_raw, {}
+            deg, self._deg = self._deg, {}
+            deg_raw, self._deg_raw = self._deg_raw, {}
         self.metrics.queue_length.set(0)
         inst = self.instance
+        tap = self.audit
         if ((hits_raw or hits) and _raw_lanes_available()
                 and inst.default_hash_routing()):
-            self._flush_hits_raw(hits, hits_raw)
+            self._flush_hits_raw(hits, hits_raw, deg, deg_raw)
             return
         for khash, (tlv, acc, seq) in hits_raw.items():
+            d = deg_raw.get(khash, 0)
             try:
                 req = self._req_from_tlv(tlv)
             except Exception:  # noqa: BLE001 - a corrupt queued TLV
@@ -257,22 +300,34 @@ class GlobalManager:
                 # poison the whole flush
                 log.warning("dropping unparseable queued TLV for key "
                             "hash %d", khash)
+                if tap is not None:
+                    # injected weight that will never apply: the audit
+                    # vector's `lost` leg (permanent drift — ISSUE 19)
+                    tap.lose(acc, d)
                 continue
             proto, a0, s0 = hits.get(req.key, (req, 0, seq))
             hits[req.key] = (req if seq >= s0 else proto, a0 + acc,
                              max(s0, seq))
+            if d:
+                deg[req.key] = deg.get(req.key, 0) + d
         if not hits:
             return
         # group by owner peer; each entry keeps its requeue tuple so a
         # failed chunk goes BACK on the queue instead of vanishing
         by_owner: Dict[str, Tuple[object, List[RateLimitRequest],
                                   List[tuple]]] = {}
+        absorbed = absorbed_deg = 0
         for key, (req, acc, seq) in hits.items():
             if acc <= 0:
                 continue
+            d = deg.get(key, 0)
             peer = self.instance.owner_of(key)
             if peer is None or self.instance.is_self(peer):
-                continue  # we are the owner: already applied locally
+                # we are the owner: already applied locally — settle
+                # the audit entry as absorbed
+                absorbed += acc
+                absorbed_deg += d
+                continue
             merged = RateLimitRequest(
                 name=req.name, unique_key=req.unique_key, hits=acc,
                 limit=req.limit, duration=req.duration,
@@ -281,7 +336,9 @@ class GlobalManager:
             addr = peer.info.grpc_address
             slot = by_owner.setdefault(addr, (peer, [], []))
             slot[1].append(merged)
-            slot[2].append((key, req, acc, seq))
+            slot[2].append((key, req, acc, seq, d))
+        if tap is not None:
+            tap.apply(absorbed, absorbed_deg, absorbed=True)
         errors = []
         for addr, (peer, reqs, entries) in by_owner.items():
             limit = self.behaviors.global_batch_limit
@@ -303,15 +360,23 @@ class GlobalManager:
                     self._record_event("error", stage="global_hits_sync",
                                        error=errors[-1])
                     break
+                if tap is not None:
+                    # the owner acked this chunk: settle its entries
+                    ent = entries[i:i + limit]
+                    tap.apply(sum(e[2] for e in ent),
+                              sum(e[4] for e in ent))
         self._record(errors)
 
-    def _flush_hits_raw(self, hits, hits_raw) -> None:
+    def _flush_hits_raw(self, hits, hits_raw, deg=None,
+                        deg_raw=None) -> None:
         """Columnar hit flush: raw-khash merge → per-key TLV with the
         aggregate hits → per-owner payloads on the forward lanes."""
         from .hashing import fnv1a64
         from .wire import req_to_tlv, tlv_with_hits
 
+        tap = self.audit
         merged: Dict[int, Tuple[object, int, int]] = dict(hits_raw)
+        degm: Dict[int, int] = dict(deg_raw or {})
         for key, (req, acc, seq) in hits.items():
             kh = fnv1a64(key.encode("utf-8"))
             cur = merged.get(kh)
@@ -321,14 +386,23 @@ class GlobalManager:
                 proto, a0, s0 = cur
                 merged[kh] = (req if seq >= s0 else proto, a0 + acc,
                               max(s0, seq))
+            d = (deg or {}).get(key, 0)
+            if d:
+                degm[kh] = degm.get(kh, 0) + d
         inst = self.instance
         by_owner: Dict[str, Tuple[object, List[bytes], List[tuple]]] = {}
+        absorbed = absorbed_deg = 0
         for kh, (proto, acc, seq) in merged.items():
             if acc <= 0:
                 continue
+            d = degm.get(kh, 0)
             peer = inst.owner_by_raw_khash(kh)
             if peer is None or inst.is_self(peer):
-                continue  # we are the owner: already applied locally
+                # we are the owner: already applied locally — settle
+                # the audit entry as absorbed
+                absorbed += acc
+                absorbed_deg += d
+                continue
             tlv = (tlv_with_hits(proto, acc) if isinstance(proto, bytes)
                    else req_to_tlv(RateLimitRequest(
                        name=proto.name, unique_key=proto.unique_key,
@@ -342,9 +416,11 @@ class GlobalManager:
             # requeue tuple keyed the way it was queued: raw-lane
             # protos under the raw khash, object-lane under the key
             if isinstance(proto, bytes):
-                slot[2].append((kh, proto, acc, seq))
+                slot[2].append((kh, proto, acc, seq, d))
             else:
-                slot[2].append((proto.key, proto, acc, seq))
+                slot[2].append((proto.key, proto, acc, seq, d))
+        if tap is not None:
+            tap.apply(absorbed, absorbed_deg, absorbed=True)
         futs = []
         limit = self.behaviors.global_batch_limit
         for addr, (peer, tlvs, entries) in by_owner.items():
@@ -375,6 +451,11 @@ class GlobalManager:
                 log.warning(errors[-1])
                 self._record_event("error", stage="global_hits_sync",
                                    error=errors[-1])
+                continue
+            if tap is not None:
+                # the owner acked this chunk: settle its entries
+                tap.apply(sum(e[2] for e in ent),
+                          sum(e[4] for e in ent))
         self._record(errors)
 
     def _run_broadcasts(self) -> None:

@@ -330,6 +330,12 @@ class V1Instance:
             # (decide + scatter in one launch) per wave bucket
             if hasattr(self.engine, "warmup_mesh_fused"):
                 self.engine.warmup_mesh_fused()
+        # Always-on conservation auditor (ISSUE 19, fleet.py): folds
+        # the GLOBAL lanes' audit vectors into a per-daemon drift doc
+        # served at GET /debug/audit and sampled by the
+        # fleet_conservation SLO below.
+        from .fleet import ConservationAuditor
+        self.auditor = ConservationAuditor(self)
         # Tenant-aware SLO plane (ISSUE 11, slo.py): multi-window
         # burn-rate verdicts over the signals the layers above emit
         # (phase ledger p99, mesh staleness, tenant RED ledger).
@@ -1289,7 +1295,7 @@ class V1Instance:
         gm = self._ensure_global_manager()
         for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask,
                                                     stamp_ms=stamp_ms):
-            gm.queue_hits_raw(k, tlv, a)
+            gm.queue_hits_raw(k, tlv, a, degraded=True)
         # flag the masked rows: re-serialize just those items with the
         # degraded metadata (pb2 — metadata has no C++ lane; this path
         # only runs mid-outage)
@@ -2010,7 +2016,7 @@ class V1Instance:
         gm = self._ensure_global_manager()
         for k, tlv, a, _i in self._raw_queue_groups(parsed, data, mask,
                                                     stamp_ms=now):
-            gm.queue_hits_raw(k, tlv, a)
+            gm.queue_hits_raw(k, tlv, a, degraded=True)
         self.metrics.degraded_served.labels(peer_addr=peer_addr).inc(m)
         ana = self.dispatcher.analytics
         tenant = None
@@ -2238,7 +2244,8 @@ class V1Instance:
                     continue
                 resp.metadata["degraded"] = "true"
                 resp.metadata["degraded_peer"] = addr
-                gm.queue_hits(self._req_stamped(reqs[i], now))
+                gm.queue_hits(self._req_stamped(reqs[i], now),
+                              degraded=True)
                 self.metrics.degraded_served.labels(
                     peer_addr=addr).inc()
         if glob_q:
@@ -2285,7 +2292,8 @@ class V1Instance:
                     if not resp.error:
                         resp.metadata["degraded"] = "true"
                         resp.metadata["degraded_peer"] = addr
-                        gm.queue_hits(self._req_stamped(req, now))
+                        gm.queue_hits(
+                            self._req_stamped(req, now), degraded=True)
                         self.metrics.degraded_served.labels(
                             peer_addr=addr).inc()
                         if resp.status == Status.OVER_LIMIT:
@@ -2847,7 +2855,8 @@ class V1Instance:
             resp.metadata["degraded"] = "true"
             resp.metadata["degraded_peer"] = addr
             gm = gm or self._ensure_global_manager()
-            gm.queue_hits(self._req_stamped(req, now))
+            gm.queue_hits(self._req_stamped(req, now),
+                          degraded=True)
             self.metrics.degraded_served.labels(peer_addr=addr).inc()
 
     # ---- GLOBAL broadcast plumbing -------------------------------------
@@ -3065,9 +3074,25 @@ class V1Instance:
                 "tenant_shed_ratio", 0.999,
                 lambda: ana.tenant_red("shed"),
                 SLO_CATALOG["tenant_shed_ratio"])
+        if self.auditor.enabled:
+            # value = seconds the audit drift has been nonzero, target
+            # = the one-flush-window staleness bound; a partition (or a
+            # real loss) holds drift nonzero past the bound and burns
+            eng.register(SLO("fleet_conservation", "threshold", 0.95,
+                             self.auditor.slo_sample,
+                             SLO_CATALOG["fleet_conservation"]))
         self.slo = eng
         self._slo_loop = IntervalLoop(
             max(int(tick_s * 1000), 10), eng.tick, name="slo-engine")
+
+    def audit_doc(self) -> dict:
+        """The conservation audit vector served at GET /debug/audit
+        (fleet.py › ConservationAuditor.doc): per-lane injected /
+        applied / queued / in-flight / degraded-pending counters and
+        the drift they prove, plus the ring view the fleet fold
+        cross-checks.  Always available — the auditor rides the GLOBAL
+        lanes' own accounting, no extra thread."""
+        return self.auditor.doc()
 
     def health_check(self) -> HealthCheckResponse:
         """reference: gubernator.go › HealthCheck — healthy + peer count,

@@ -375,6 +375,14 @@ class Metrics:
             "multiple; breach latches when fast AND slow exceed the "
             "threshold — see GET /debug/slo); tenant label empty for "
             "instance-level SLOs", ["slo", "tenant"], registry=r)
+        self.fleet_conservation_drift = Gauge(
+            "gubernator_fleet_conservation_drift",
+            "conservation drift this daemon contributes to the fleet "
+            "fold: GLOBAL hits injected minus applied across both "
+            "backends (nonzero while flushes fail or are in flight; "
+            "held nonzero past the flush-window bound it burns the "
+            "fleet_conservation SLO — see GET /debug/audit)",
+            registry=r)
         self.memledger_bytes = Gauge(
             "gubernator_memledger_bytes",
             "live bytes per memory-ledger consumer (host-side "

@@ -88,6 +88,9 @@ ORACLE_KINDS = {
            "expect.slo_clean makes breaches a failure)",
     "trace_assembly": "force-sampled spans assemble into >=1 "
                       "multi-span trace with a wave child",
+    "fleet_audit": "the live conservation auditors' folded drift "
+                   "(fleet.fold_audits over every daemon's own "
+                   "/debug/audit vector) drains to zero post-heal",
 }
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -828,6 +831,43 @@ class ScenarioRunner:
         return {"ok": ok, "engines": present,
                 "breached": sorted(set(breached))}
 
+    def _oracle_fleet_audit(self, handle: _StackHandle,
+                            fast: bool) -> dict:
+        """The live auditors' verdict (ISSUE 19): fold every daemon's
+        OWN audit vector (instance.audit_doc — the same document
+        GET /debug/audit serves) with fleet.fold_audits and require
+        fleet drift == 0 once reconcile settles.  No test-harness
+        walking: the daemons prove conservation themselves.  Stacks
+        without a GLOBAL backend trivially conserve (all-zero
+        vectors), so the oracle is armed per-spec on clustered/mesh
+        scenarios where the flush discipline actually runs."""
+        from . import fleet
+
+        def fold():
+            return fleet.fold_audits(
+                [inst.audit_doc() for inst in handle.instances])
+
+        deadline = time.perf_counter() + \
+            (15.0 if fast else self.SETTLE_TIMEOUT_S)
+        f = fold()
+        while not f["conserved"] and time.perf_counter() < deadline:
+            for inst in handle.instances:
+                gm = getattr(inst, "global_manager", None)
+                loop = getattr(gm, "_hits_loop", None)
+                if loop is not None:
+                    loop.poke()
+            time.sleep(0.2)
+            f = fold()
+        ring = fleet.ring_verdict(
+            [inst.audit_doc() for inst in handle.instances])
+        return {"ok": f["conserved"] and ring["consistent"],
+                "drift": f["drift"],
+                "injected": f["totals"]["injected"],
+                "applied": f["totals"]["applied"],
+                "lost": f["totals"]["lost"],
+                "max_drain_age_s": f["max_drain_age_s"],
+                "ring_consistent": ring["consistent"]}
+
     def _oracle_trace_assembly(self, handle: _StackHandle) -> dict:
         """Force-sampled spans from every instance must assemble into
         at least one multi-span trace carrying a wave child — the
@@ -902,6 +942,9 @@ class ScenarioRunner:
                     handle, judge, end_now, fast)
             if "slo" in spec.oracles:
                 oracles["slo"] = self._oracle_slo(handle)
+            if "fleet_audit" in spec.oracles:
+                oracles["fleet_audit"] = self._oracle_fleet_audit(
+                    handle, fast)
             if "trace_assembly" in spec.oracles:
                 oracles["trace_assembly"] = \
                     self._oracle_trace_assembly(handle)

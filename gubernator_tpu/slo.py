@@ -63,6 +63,11 @@ SLO_CATALOG: Dict[str, str] = {
     "hbm_pressure": "byte-weighted device occupancy fraction vs "
                     "GUBER_MEM_PRESSURE (fires before table-full / "
                     "cap-overflow starts demoting)",
+    "fleet_conservation": "seconds the GLOBAL audit drift (injected "
+                          "minus applied, both backends) has been "
+                          "nonzero vs the one-flush-window bound "
+                          "(2x GUBER_GLOBAL_SYNC_WAIT or "
+                          "GUBER_FLEET_DRIFT_BOUND)",
 }
 
 DEFAULT_FAST_S = 60.0

@@ -269,6 +269,65 @@ def test_scenario_ab_block_schema():
         inst.close()
 
 
+def test_fleet_section_child_writes_row(tmp_path):
+    """The 16_fleet row (ISSUE 19) through the driver's real child
+    protocol: the audit-tap A/B must land under its < 1% budget shape
+    (schema pinned; the verdict bool is what bench-diff latches), and
+    the 3-daemon fleet merge must measure a conserved steady state —
+    drift exactly zero, tenant rollup sum-exact — with a finite merge
+    wall time."""
+    rows = _run_section("fleet", tmp_path, timeout=600)
+    r = rows["16_fleet"]
+    ab = r["audit_ab"]
+    assert "error" not in ab, ab
+    for k in ("overhead_pct", "overhead_ok", "on_calls_per_s",
+              "off_calls_per_s", "pairs", "reps"):
+        assert k in ab, (k, ab)
+    assert isinstance(ab["overhead_ok"], bool)
+    assert ab["on_calls_per_s"] > 0
+    assert ab["off_calls_per_s"] > 0
+    m = r["merge"]
+    assert "error" not in m, m
+    assert m["daemons"] == 3
+    assert m["drift"] == 0
+    assert m["conserved_ok"] is True
+    assert m["tenants_sum_ok"] is True
+    assert r["fleet_merge_wall_ms"] > 0
+
+
+def test_audit_ab_block_schema():
+    """The 16_fleet ``audit_ab`` block run directly on a small
+    instance: schema + that the A/B restores the tap it toggled."""
+    sys.path.insert(0, REPO)
+    import bench
+    from gubernator_tpu.config import Config
+    from gubernator_tpu.instance import V1Instance
+    from gubernator_tpu.types import Behavior, RateLimitRequest
+
+    # a real engine: the A/B drives the columnar GLOBAL wire lane,
+    # which the pure-python OracleEngine reference lane doesn't serve
+    inst = V1Instance(Config(cache_size=1 << 10, sweep_interval_ms=0))
+    try:
+        reqs = [[RateLimitRequest(name="ab", unique_key=f"k{i}",
+                                  hits=1, limit=1000,
+                                  duration=86_400_000,
+                                  behavior=Behavior.GLOBAL)
+                 for i in range(4)]]
+        datas = bench._serialize_reqs(reqs)
+        row = bench._audit_ab(inst, datas, pairs=2, reps=4)
+        assert "error" not in row, row
+        for k in ("overhead_pct", "overhead_ok", "on_calls_per_s",
+                  "off_calls_per_s", "pairs", "reps"):
+            assert k in row, (k, row)
+        assert isinstance(row["overhead_ok"], bool)
+        assert row["on_calls_per_s"] > 0
+        assert row["pairs"] == 2 and row["reps"] == 4
+        # the A/B restores the tap it toggled
+        assert inst.global_manager.audit is not None
+    finally:
+        inst.close()
+
+
 def test_section_registry_covers_baseline_rows():
     """Every BASELINE row key the orchestrator may need to error-fill
     is declared by exactly one section."""
@@ -282,7 +341,7 @@ def test_section_registry_covers_baseline_rows():
                 "6_service_path", "7_hot_psum", "8_peer_path",
                 "9_clustered_service", "10_reuseport_group",
                 "11_pallas_serving", "12_mesh_global",
-                "13_tiered_store", "15_scenarios"]:
+                "13_tiered_store", "15_scenarios", "16_fleet"]:
         assert row in declared, row
     for name in bench._SECTION_ORDER:
         assert name in bench._SECTIONS

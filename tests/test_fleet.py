@@ -1,0 +1,571 @@
+"""Fleet watchtower (ISSUE 19): merge exactness + audit conservation.
+
+Pins the three acceptance-critical properties of the fleet plane:
+
+- **Sketch merge exactness** — a 3-daemon key-partitioned workload's
+  merged heavy-hitter sketch is byte-equal (canonical_bytes) to a
+  single ground-truth sketch fed the union stream.
+- **Tenant rollup Σ-equality** — the fleet tenant RED rollup's
+  per-tenant sums equal the per-daemon ledgers' sums, exactly, on a
+  live cluster.
+- **Audit conservation under chaos** — 16 threads hammer GLOBAL keys
+  across a 3-daemon cluster through a peer_send:error window; once the
+  fault clears, every daemon's OWN /debug/audit vector drains to
+  drift == 0 with zero lost weight (the identity
+  ``injected == applied + queued + in_flight + lost`` settles).
+
+Plus unit coverage for the pure fold functions (fold_audits,
+ring_verdict, RingWatch, merge_slo/memory/status/tenants) and the
+AuditTap ledger itself.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import Behavior, RateLimitRequest
+from gubernator_tpu import cluster as cluster_mod
+from gubernator_tpu import fleet
+from gubernator_tpu.analytics import HeavyHitterSketch
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.fleet import (AuditTap, RingWatch, drift_bound_s,
+                                  fold_audits, merge_memory, merge_slo,
+                                  merge_status, merge_tenants,
+                                  merge_topkeys, ring_verdict)
+from gubernator_tpu.proto import gubernator_pb2 as pb
+
+DAY = 24 * 3_600_000
+NOW0 = 1_790_000_000_000
+LIMIT = 10 ** 6
+
+
+def serialize(reqs):
+    msg = pb.GetRateLimitsReq()
+    for r in reqs:
+        m = msg.requests.add()
+        m.name = r.name
+        m.unique_key = r.unique_key
+        m.hits = r.hits
+        m.limit = r.limit
+        m.duration = r.duration
+        m.algorithm = int(r.algorithm)
+        m.behavior = int(r.behavior)
+        m.burst = r.burst
+    return msg.SerializeToString()
+
+
+def g_one(key: str, hits: int, name: str = "fleet") -> bytes:
+    return serialize([RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=LIMIT,
+        duration=DAY, behavior=Behavior.GLOBAL)])
+
+
+def wait_until(pred, timeout=30.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# AuditTap: the sender-side double-entry ledger
+# ---------------------------------------------------------------------------
+
+
+class TestAuditTap:
+    def test_identity_settles(self):
+        tap = AuditTap()
+        tap.inject(10)
+        tap.inject(5, degraded=True)
+        s = tap.snapshot()
+        assert (s["injected"], s["deg_injected"]) == (15, 5)
+        assert s["applied"] == 0
+        tap.apply(10)
+        tap.apply(5, deg=5)
+        s = tap.snapshot()
+        assert s["injected"] == s["applied"] == 15
+        assert s["deg_applied"] == 5
+        # backlog (the drift gauge) is now exactly zero
+        assert s["injected"] - s["applied"] == 0
+        assert s["deg_injected"] - s["deg_applied"] == 0
+
+    def test_lose_settles_degraded_share(self):
+        tap = AuditTap()
+        tap.inject(8, degraded=True)
+        tap.lose(8, deg=8)
+        s = tap.snapshot()
+        # lost weight never applies, but its degraded debt is settled
+        assert s["lost"] == 8 and s["applied"] == 0
+        assert s["deg_injected"] - s["deg_applied"] == 0
+        # backlog stays nonzero forever: the loss detector
+        assert s["injected"] - s["applied"] == 8
+
+    def test_absorbed_is_subset_of_applied(self):
+        tap = AuditTap()
+        tap.inject(7)
+        tap.apply(3, absorbed=True)
+        tap.apply(4)
+        s = tap.snapshot()
+        assert s["applied"] == 7 and s["absorbed"] == 3
+
+    def test_nonpositive_noop(self):
+        tap = AuditTap()
+        tap.inject(0)
+        tap.inject(-3, degraded=True)
+        tap.apply(0)
+        tap.lose(-1)
+        assert tap.snapshot() == {"injected": 0, "applied": 0,
+                                  "deg_injected": 0, "deg_applied": 0,
+                                  "absorbed": 0, "lost": 0}
+
+
+class TestDriftBound:
+    def test_default_is_two_flush_windows(self, monkeypatch):
+        monkeypatch.delenv("GUBER_FLEET_DRIFT_BOUND", raising=False)
+        b = BehaviorConfig(global_sync_wait_ms=250)
+        assert drift_bound_s(b) == pytest.approx(0.5)
+
+    def test_floor_at_100ms_window(self, monkeypatch):
+        monkeypatch.delenv("GUBER_FLEET_DRIFT_BOUND", raising=False)
+        b = BehaviorConfig(global_sync_wait_ms=10)
+        assert drift_bound_s(b) == pytest.approx(0.2)
+
+    def test_env_override_and_bad_value_fallback(self, monkeypatch):
+        b = BehaviorConfig(global_sync_wait_ms=250)
+        monkeypatch.setenv("GUBER_FLEET_DRIFT_BOUND", "1500ms")
+        assert drift_bound_s(b) == pytest.approx(1.5)
+        monkeypatch.setenv("GUBER_FLEET_DRIFT_BOUND", "bogus")
+        assert drift_bound_s(b) == pytest.approx(0.5)
+
+    def test_audit_enabled_gate(self, monkeypatch):
+        monkeypatch.delenv("GUBER_FLEET_AUDIT", raising=False)
+        assert fleet.audit_enabled()
+        monkeypatch.setenv("GUBER_FLEET_AUDIT", "0")
+        assert not fleet.audit_enabled()
+
+
+# ---------------------------------------------------------------------------
+# pure folds over synthetic /debug documents
+# ---------------------------------------------------------------------------
+
+
+def _audit_doc(inst, backlog=0, queued=0, lost=0, injected=100,
+               drain_age=0.0, membership=("a:1", "b:1"),
+               ejected=(), flush_ms=100, mesh_backlog=None):
+    applied = injected - backlog
+    lanes = {"global": {
+        "injected": injected, "applied": applied,
+        "deg_injected": 0, "deg_applied": 0, "absorbed": 0,
+        "lost": lost, "queued": queued, "deg_queued": 0,
+        "backlog": backlog,
+        "in_flight": backlog - queued - lost, "deg_pending": 0}}
+    drift = backlog
+    if mesh_backlog is not None:
+        lanes["mesh"] = {"injected": mesh_backlog + 50,
+                         "folded": 50, "backlog": mesh_backlog,
+                         "generation": 1, "pinned_keys": 0,
+                         "last_staleness_s": 0.0}
+        drift += mesh_backlog
+    membership = list(membership)
+    ejected = list(ejected)
+    return {"instance": inst, "enabled": True, "drift": drift,
+            "conserved": drift == 0, "lost": lost,
+            "drain_age_s": drain_age, "bound_s": 0.2,
+            "flush_window_ms": flush_ms,
+            "lanes": lanes,
+            "ring": {"generation": 3, "self": inst,
+                     "membership": membership,
+                     "routing": [a for a in membership
+                                 if a not in set(ejected)],
+                     "ejected": ejected}}
+
+
+class TestFoldAudits:
+    def test_conserved_fleet(self):
+        docs = [_audit_doc("a:1"), _audit_doc("b:1")]
+        f = fold_audits(docs)
+        assert f["daemons"] == 2 and f["conserved"]
+        assert f["drift"] == 0
+        assert f["totals"]["injected"] == 200
+        assert f["totals"]["applied"] == 200
+        assert len(f["per_daemon"]) == 2
+        assert f["staleness_bound_s"] == pytest.approx(0.1)
+
+    def test_drift_sums_exactly(self):
+        docs = [_audit_doc("a:1", backlog=7, queued=4),
+                _audit_doc("b:1", backlog=5, queued=0, lost=5,
+                           drain_age=3.5),
+                _audit_doc("c:1", mesh_backlog=2)]
+        f = fold_audits(docs)
+        assert f["drift"] == 14 and not f["conserved"]
+        assert f["totals"]["queued"] == 4
+        assert f["totals"]["lost"] == 5
+        assert f["totals"]["in_flight"] == 3
+        assert f["totals"]["mesh_injected"] == 52
+        assert f["totals"]["mesh_folded"] == 50
+        assert f["max_drain_age_s"] == pytest.approx(3.5)
+        by = {r["instance"]: r for r in f["per_daemon"]}
+        assert by["a:1"]["drift"] == 7 and by["b:1"]["lost"] == 5
+
+
+class TestRingVerdict:
+    def test_consistent(self):
+        v = ring_verdict([_audit_doc("a:1"), _audit_doc("b:1")])
+        assert v["consistent"] and v["reasons"] == []
+        assert v["ejected"] == []
+
+    def test_membership_mismatch(self):
+        v = ring_verdict([
+            _audit_doc("a:1", membership=("a:1", "b:1")),
+            _audit_doc("b:1", membership=("a:1", "b:1", "c:1"))])
+        assert not v["consistent"]
+        assert "membership_mismatch" in v["reasons"]
+
+    def test_ejection_diverges_routing(self):
+        v = ring_verdict([
+            _audit_doc("a:1", ejected=("b:1",)),
+            _audit_doc("b:1")])
+        assert not v["consistent"]
+        assert "peers_ejected" in v["reasons"]
+        assert "routing_mismatch" in v["reasons"]
+        assert v["ejected"] == ["b:1"]
+
+    def test_generations_reported_never_compared(self):
+        docs = [_audit_doc("a:1"), _audit_doc("b:1")]
+        docs[0]["ring"]["generation"] = 2
+        docs[1]["ring"]["generation"] = 9
+        v = ring_verdict(docs)
+        # per-daemon local counters: disagreement is NOT divergence
+        assert v["consistent"]
+        assert v["generations"] == {"a:1": 2, "b:1": 9}
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+class TestRingWatch:
+    def test_edge_triggered_latch(self):
+        rec = _Recorder()
+        w = RingWatch()
+        ok = [_audit_doc("a:1"), _audit_doc("b:1")]
+        bad = [_audit_doc("a:1", ejected=("b:1",)), _audit_doc("b:1")]
+        w.check(ok, recorder=rec)
+        assert rec.events == []  # consistent start: nothing fires
+        w.check(bad, recorder=rec)
+        w.check(bad, recorder=rec)  # held divergence does NOT refire
+        kinds = [k for k, _ in rec.events]
+        assert kinds == ["fleet_ring_divergence"]
+        assert rec.events[0][1]["reasons"] != ""
+        w.check(ok, recorder=rec)
+        w.check(ok, recorder=rec)  # held convergence does NOT refire
+        kinds = [k for k, _ in rec.events]
+        assert kinds == ["fleet_ring_divergence",
+                         "fleet_ring_converged"]
+
+
+class TestMergeSlo:
+    def _doc(self, breached, fast, slow, ticks=10):
+        return {"ticks": ticks, "slos": [
+            {"slo": "availability", "kind": "ratio",
+             "objective": 0.999, "breached": breached,
+             "fast_burn": fast, "slow_burn": slow},
+            {"slo": "fleet_conservation", "kind": "threshold",
+             "objective": 0.95, "breached": False,
+             "fast_burn": 0.0, "slow_burn": 0.0,
+             "value": 0.0, "target": 0.2}]}
+
+    def test_worst_of_latch_and_summed_burn(self):
+        f = merge_slo([self._doc(False, 0.5, 0.1),
+                       self._doc(True, 2.0, 0.4)])
+        assert f["daemons"] == 2 and f["ticks"] == 20
+        assert f["breached"] == ["availability"]
+        row = {r["slo"]: r for r in f["slos"]}["availability"]
+        assert row["breached"] and row["daemons"] == 2
+        assert row["fast_burn_max"] == pytest.approx(2.0)
+        assert row["fast_burn_sum"] == pytest.approx(2.5)
+        assert row["slow_burn_sum"] == pytest.approx(0.5)
+        fc = {r["slo"]: r for r in f["slos"]}["fleet_conservation"]
+        assert fc["value_max"] == 0.0 and fc["target"] == 0.2
+
+
+class TestMergeTenants:
+    def _doc(self, a, b):
+        return {"enabled": True,
+                "tenants": {
+                    "acme": {f: a for f in fleet.TENANT_FIELDS},
+                    "bob": {f: b for f in fleet.TENANT_FIELDS}},
+                "totals": {f: a + b for f in fleet.TENANT_FIELDS}}
+
+    def test_sum_equality_asserted(self):
+        f = merge_tenants([self._doc(3, 5), self._doc(7, 11)])
+        assert f["conserved"] and f["mismatched_daemons"] == []
+        assert f["tenants"]["acme"]["requests"] == 10
+        assert f["tenants"]["bob"]["hits"] == 16
+        assert f["totals"]["requests"] == 26
+
+    def test_mismatch_flags_source_daemon(self):
+        bad = self._doc(3, 5)
+        bad["totals"]["hits"] += 1  # daemon lies about its own sum
+        f = merge_tenants([self._doc(1, 1), bad])
+        assert not f["conserved"]
+        assert f["mismatched_daemons"] == [1]
+
+    def test_disabled_daemon_skipped(self):
+        f = merge_tenants([self._doc(2, 2), {"enabled": False}])
+        assert f["enabled_daemons"] == 1 and f["conserved"]
+
+
+class TestMergeMemoryAndStatus:
+    def test_memory_fold(self):
+        f = merge_memory([
+            {"device_bytes": 100, "host_bytes": 10, "pressure": 0.2,
+             "consumers": {"cache": {"bytes": 100}}},
+            {"device_bytes": 50, "host_bytes": 20, "pressure": 0.9,
+             "consumers": {"cache": {"bytes": 40},
+                           "sketch": {"bytes": 10}}}])
+        assert f["device_bytes"] == 150 and f["host_bytes"] == 30
+        assert f["max_pressure"] == pytest.approx(0.9)
+        assert f["consumer_bytes"] == {"cache": 140, "sketch": 10}
+
+    def test_status_with_conservation(self):
+        f = merge_status(
+            [{"status": "healthy", "peer_count": 3},
+             {"status": "unreachable"}],
+            audit_docs=[_audit_doc("a:1", backlog=4)])
+        assert f["daemons"] == 2 and f["healthy"] == 1
+        assert f["ring"]["consistent"]
+        assert f["conservation"] == {"drift": 4, "conserved": False}
+
+
+# ---------------------------------------------------------------------------
+# sketch merge exactness: key-partitioned fleet == union-stream truth
+# ---------------------------------------------------------------------------
+
+
+class TestSketchMergeExactness:
+    K, WIDTH, DAEMONS, KEYS_PER = 64, 256, 3, 60
+
+    def _waves(self):
+        """Per-daemon key-partitioned waves: daemon d owns khashes
+        d*1000+i — disjoint sets, 180 distinct keys total < width, so
+        every sketch (per-daemon, merged, ground truth) is EXACT."""
+        rng = np.random.default_rng(19)
+        out = []
+        for d in range(self.DAEMONS):
+            kh = np.arange(d * 1000 + 1,
+                           d * 1000 + 1 + self.KEYS_PER,
+                           dtype=np.uint64)
+            waves = []
+            for w in range(4):
+                pick = rng.integers(0, self.KEYS_PER, size=120)
+                hits = rng.integers(1, 40, size=120).astype(np.int64)
+                over = hits > 35
+                waves.append((kh[pick], hits, over,
+                              NOW0 + 1000 * w))
+            out.append(waves)
+        return out
+
+    def test_merged_sketch_byte_equals_union_ground_truth(self):
+        per_daemon = self._waves()
+        truth = HeavyHitterSketch(k=self.K, width=self.WIDTH)
+        docs = []
+        for d, waves in enumerate(per_daemon):
+            sk = HeavyHitterSketch(k=self.K, width=self.WIDTH)
+            for kh, hits, over, t in waves:
+                sk.update(kh, hits, over, t)
+                truth.update(kh, hits, over, t)
+            # the /debug/topkeys document shape merge_topkeys consumes
+            rows = sk.topk(self.WIDTH)
+            assert all(e["err"] == 0 for e in rows), "per-daemon exact"
+            docs.append({
+                "k": self.K, "width": self.WIDTH,
+                "total_hits_observed": int(sk.total_weight),
+                "keys": [dict(e, khash=f"0x{e['khash']:016x}",
+                              owner=f"d{d}:105{d}") for e in rows]})
+        merged = HeavyHitterSketch(k=self.K, width=self.WIDTH)
+        for doc in docs:
+            merged.merge_entries(doc["keys"],
+                                 total_weight=doc
+                                 ["total_hits_observed"])
+        assert merged.canonical_bytes() == truth.canonical_bytes()
+
+    def test_merge_topkeys_fold_matches_truth(self):
+        per_daemon = self._waves()
+        truth = HeavyHitterSketch(k=self.K, width=self.WIDTH)
+        docs = []
+        for d, waves in enumerate(per_daemon):
+            sk = HeavyHitterSketch(k=self.K, width=self.WIDTH)
+            for kh, hits, over, t in waves:
+                sk.update(kh, hits, over, t)
+                truth.update(kh, hits, over, t)
+            docs.append({
+                "k": self.K, "width": self.WIDTH,
+                "total_hits_observed": int(sk.total_weight),
+                "keys": [dict(e, khash=f"0x{e['khash']:016x}",
+                              owner=f"d{d}:105{d}")
+                         for e in sk.topk(self.WIDTH)]})
+        out = merge_topkeys(docs, k=self.K)
+        assert out["daemons"] == self.DAEMONS
+        assert out["total_hits_observed"] == int(truth.total_weight)
+        assert out["admission_error_bound"] == 0
+        want = {f"0x{e['khash']:016x}": e["hits"]
+                for e in truth.topk(self.K)}
+        got = {e["khash"]: e["hits"] for e in out["keys"]}
+        assert got == want
+        # ring-owner attribution survives the merge
+        owners = {e["khash"]: e["owner"] for e in out["keys"]}
+        for h, o in owners.items():
+            d = (int(h, 16) - 1) // 1000
+            assert o == f"d{d}:105{d}"
+
+
+# ---------------------------------------------------------------------------
+# live cluster: tenant Σ-equality + conservation under chaos soak
+# ---------------------------------------------------------------------------
+
+SOAK_B = BehaviorConfig(
+    batch_timeout_ms=400, batch_wait_ms=100,
+    peer_retry_limit=1, peer_retry_backoff_ms=5,
+    peer_circuit_threshold=2, peer_circuit_cooldown_ms=250,
+    peer_eject_after_ms=300, peer_readmit_after_ms=250,
+    global_sync_wait_ms=100)
+
+
+def _settle_conserved(c, n, timeout=30.0):
+    """Poke every daemon's GLOBAL flush loop until every daemon's OWN
+    audit vector reports conserved (no test-harness ledger walking)."""
+    def drained():
+        docs = []
+        for i in range(n):
+            inst = c.instance_at(i)
+            gm = inst.global_manager
+            if gm is not None:
+                gm.poke()
+            docs.append(inst.audit_doc())
+        return all(d["conserved"] for d in docs)
+    wait_until(drained, timeout=timeout, interval=0.2,
+               what="fleet audit drift to drain to zero")
+    return [c.instance_at(i).audit_doc() for i in range(n)]
+
+
+class TestFleetClusterLive:
+    def test_tenant_rollup_sum_equality(self):
+        pytest.importorskip("gubernator_tpu.ops._native")
+        c = cluster_mod.start(3, behaviors=BehaviorConfig(
+            global_sync_wait_ms=50))
+        try:
+            sent = {f"team{t}": 0 for t in range(3)}
+            for i in range(3):
+                inst = c.instance_at(i)
+                for r in range(12):
+                    t = f"team{r % 3}"
+                    inst.get_rate_limits_wire(
+                        g_one(f"trk{i}_{r}", 2, name=f"{t}/svc"),
+                        now_ms=NOW0 + r)
+                    sent[t] += 1
+                assert inst.analytics.flush(timeout=10.0)
+            docs = [c.instance_at(i).analytics.tenants_snapshot()
+                    for i in range(3)]
+            f = merge_tenants(docs)
+            assert f["conserved"], f["mismatched_daemons"]
+            assert f["enabled_daemons"] == 3
+            for t, n in sent.items():
+                assert f["tenants"][t]["requests"] == n
+                assert f["tenants"][t]["hits"] == 2 * n
+            # GLOBAL reconcile/broadcast rows land in other buckets
+            # (hash-only columnar rows have no tenant name), so the
+            # fleet totals dominate the named sends; the Σ-equality
+            # proper is f["conserved"] above
+            assert f["totals"]["requests"] >= sum(sent.values())
+        finally:
+            c.stop()
+
+    def test_audit_conservation_under_chaos_soak(self):
+        """16 threads × GLOBAL keys × a peer_send:error window: the
+        fault forces flush retries/requeues mid-soak; after it clears,
+        every daemon's own audit vector settles to drift == 0 with
+        zero lost weight, and the fleet fold proves Σinjected ==
+        Σapplied."""
+        pytest.importorskip("gubernator_tpu.ops._native")
+        c = cluster_mod.start(3, behaviors=SOAK_B)
+        try:
+            keys = [f"soak{i}" for i in range(12)]
+            errs = []
+            fault_on = threading.Event()
+
+            def worker(t):
+                inst = c.instance_at(t % 3)
+                try:
+                    for r in range(24):
+                        if t == 0 and r == 8:
+                            # mid-soak partition: daemon 0's sends err
+                            c.instance_at(0).faults.arm(
+                                "peer_send:error", seed=7)
+                            fault_on.set()
+                        out = pb.GetRateLimitsResp.FromString(
+                            inst.get_rate_limits_wire(
+                                g_one(keys[(t + r) % len(keys)], 1),
+                                now_ms=NOW0 + 1 + r))
+                        assert len(out.responses) == 1
+                        # GLOBAL serves from the local replica: the
+                        # partition must not surface caller errors
+                        assert out.responses[0].error == ""
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            ths = [threading.Thread(target=worker, args=(t,))
+                   for t in range(16)]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join(timeout=120)
+            assert not any(th.is_alive() for th in ths), "stuck caller"
+            assert not errs, errs[:3]
+            assert fault_on.is_set()
+            c.instance_at(0).faults.clear()
+
+            docs = _settle_conserved(c, 3)
+            f = fold_audits(docs)
+            assert f["conserved"] and f["drift"] == 0
+            assert f["totals"]["injected"] > 0
+            assert f["totals"]["injected"] == f["totals"]["applied"]
+            assert f["totals"]["lost"] == 0
+            assert f["totals"]["queued"] == 0
+            assert f["totals"]["in_flight"] == 0
+            for d in docs:
+                g = d["lanes"]["global"]
+                assert g["injected"] == (g["applied"] + g["queued"]
+                                         + g["in_flight"] + g["lost"])
+            # the ring reconverges once the readmit window passes;
+            # readmission needs live probes (the circuit half-opens
+            # on traffic), so keep a trickle flowing while we wait
+            probe = [0]
+
+            def reconverged():
+                probe[0] += 1
+                for i in range(3):
+                    inst = c.instance_at(i)
+                    inst.get_rate_limits_wire(
+                        g_one(keys[probe[0] % len(keys)], 0),
+                        now_ms=NOW0 + 10_000 + probe[0])
+                    gm = inst.global_manager
+                    if gm is not None:
+                        gm.poke()
+                return ring_verdict(
+                    [c.instance_at(i).audit_doc()
+                     for i in range(3)])["consistent"]
+
+            wait_until(reconverged, timeout=15.0, interval=0.2,
+                       what="ring reconvergence")
+            docs = _settle_conserved(c, 3)
+            assert fold_audits(docs)["conserved"]
+        finally:
+            c.stop()

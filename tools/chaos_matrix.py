@@ -734,13 +734,166 @@ def _trace_plane_cell() -> dict:
     return cell
 
 
+def _fleet_conservation_cell() -> dict:
+    """peer_send:error partition → GLOBAL flushes to the owner fail
+    and requeue → the daemons' OWN audit vectors (instance.audit_doc,
+    the same document GET /debug/audit serves — no test-harness
+    walking) show nonzero fleet drift and the ``fleet_conservation``
+    SLO breaches once the backlog outlives its flush-window bound;
+    healing the partition must drain the drift to EXACTLY zero and
+    emit ``slo_recovered`` (ISSUE 19 acceptance)."""
+    from gubernator_tpu import cluster as cluster_mod
+    from gubernator_tpu import fleet
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.types import Behavior
+
+    spec = "peer_send:error"
+    cell = {"cell": "fleet_conservation", "slo": "fleet_conservation",
+            "spec": spec}
+    t0 = time.perf_counter()
+    c = cluster_mod.start(3, behaviors=BehaviorConfig(
+        batch_timeout_ms=300, batch_wait_ms=50,
+        peer_retry_limit=1, peer_retry_backoff_ms=5,
+        peer_circuit_threshold=2, peer_circuit_cooldown_ms=200,
+        global_sync_wait_ms=100))
+    try:
+        i0 = c.instance_at(0)
+        remote = None
+        for i in range(200):
+            k = f"fc{i}"
+            if c.owner_daemon_of("chaos_" + k) is not c.daemon_at(0):
+                remote = k
+                break
+        assert remote
+
+        def fold():
+            return fleet.fold_audits(
+                [c.instance_at(i).audit_doc() for i in range(3)])
+
+        def drive():
+            i0.get_rate_limits_wire(_one(
+                remote, behavior=int(Behavior.GLOBAL)), now_ms=NOW0)
+            gm = i0.global_manager
+            if gm is not None:
+                gm.poke()
+            i0.slo.tick()
+
+        drive()  # clean baseline: flush lands, drift drains
+        i0.faults.arm(spec, seed=7)
+        deadline = time.monotonic() + 15.0
+        drift_seen = breached = False
+        while time.monotonic() < deadline \
+                and not (drift_seen and breached):
+            drive()  # flush fails → requeue → backlog holds nonzero
+            drift_seen = drift_seen or fold()["drift"] > 0
+            breached = _slo_events(i0, "slo_breach",
+                                   "fleet_conservation")
+            time.sleep(0.05)
+        i0.faults.clear()
+        recovered = drained = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and breached \
+                and not (recovered and drained):
+            drive()  # circuit half-opens, flush lands, backlog drains
+            f = fold()
+            drained = f["conserved"] and f["totals"]["injected"] > 0
+            recovered = _slo_events(i0, "slo_recovered",
+                                    "fleet_conservation")
+            time.sleep(0.1)
+        final = fold()
+    finally:
+        c.stop()
+    cell.update({"drift_seen": drift_seen, "breached": breached,
+                 "recovered": recovered, "drained": drained,
+                 "final_drift": final["drift"],
+                 "elapsed_ms": round((time.perf_counter() - t0) * 1000,
+                                     1),
+                 "ok": (drift_seen and breached and recovered
+                        and drained and final["drift"] == 0)})
+    return cell
+
+
+def _fleet_ring_divergence_cell() -> dict:
+    """sustained peer_send:error holds a peer's circuit open past
+    ``peer_eject_after_ms`` → the routing gate ejects it → the audit
+    docs' ring views disagree (routing != membership) and the fleet
+    watch emits ``fleet_ring_divergence``; clearing the fault lets the
+    peer recover and readmit, and the watch must emit the matching
+    ``fleet_ring_converged`` (ISSUE 19 satellite)."""
+    from gubernator_tpu import cluster as cluster_mod
+    from gubernator_tpu import fleet
+    from gubernator_tpu.config import BehaviorConfig
+
+    spec = "peer_send:error"
+    cell = {"cell": "fleet_ring_divergence", "spec": spec}
+    t0 = time.perf_counter()
+    c = cluster_mod.start(2, behaviors=BehaviorConfig(
+        batch_timeout_ms=300, batch_wait_ms=50,
+        peer_retry_limit=1, peer_retry_backoff_ms=5,
+        peer_circuit_threshold=2, peer_circuit_cooldown_ms=250,
+        peer_eject_after_ms=300, peer_readmit_after_ms=250))
+    try:
+        i0 = c.instance_at(0)
+        remote = None
+        for i in range(200):
+            k = f"rd{i}"
+            if c.owner_daemon_of("chaos_" + k) is c.daemon_at(1):
+                remote = k
+                break
+        assert remote
+        watch = fleet.RingWatch()
+
+        def check():
+            # the fleet tick: fold the daemons' own ring views; the
+            # watch records divergence/convergence edges into daemon
+            # 0's flight recorder
+            return watch.check(
+                [c.instance_at(i).audit_doc() for i in range(2)],
+                recorder=i0.recorder)
+
+        def fired(kind):
+            return any(e.get("kind") == kind
+                       for e in i0.recorder.events())
+
+        assert check()["consistent"]
+        i0.faults.arm(spec, seed=7)
+        deadline = time.monotonic() + 15.0
+        diverged = False
+        while time.monotonic() < deadline and not diverged:
+            # forwarded traffic trips the circuit; routing lookups
+            # derive the gated picker, ejecting the dead peer
+            i0.get_rate_limits_wire(_one(remote), now_ms=NOW0)
+            diverged = not check()["consistent"] \
+                and fired("fleet_ring_divergence")
+            time.sleep(0.05)
+        i0.faults.clear()
+        converged = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and diverged and not converged:
+            # light traffic half-opens the circuit; once recovered
+            # past readmit the gate clears and the views re-agree
+            i0.get_rate_limits_wire(_one(remote), now_ms=NOW0)
+            converged = check()["consistent"] \
+                and fired("fleet_ring_converged")
+            time.sleep(0.1)
+    finally:
+        c.stop()
+    cell.update({"diverged": diverged, "converged": converged,
+                 "elapsed_ms": round((time.perf_counter() - t0) * 1000,
+                                     1),
+                 "ok": diverged and converged})
+    return cell
+
+
 def run_slo_cells(verbose=False) -> list:
     old = {k: os.environ.get(k) for k in _SLO_ENV}
     os.environ.update(_SLO_ENV)
     cells = []
     try:
         for fn in (_slo_staleness_cell, _slo_error_ratio_cell,
-                   _memory_pressure_cell, _trace_plane_cell):
+                   _memory_pressure_cell, _trace_plane_cell,
+                   _fleet_conservation_cell,
+                   _fleet_ring_divergence_cell):
             cell = fn()
             cells.append(cell)
             if verbose:

@@ -1,0 +1,84 @@
+"""3-daemon fleet conservation smoke (ISSUE 19, `make fleet-audit`).
+
+Boots a 3-daemon cluster, drives GLOBAL traffic from every daemon
+(owned + remote-owned keys so the flush lane actually crosses the
+wire), lets the flush discipline settle, then fetches each daemon's
+OWN ``GET /debug/audit`` vector over HTTP — no test-harness walking —
+and folds them with fleet.fold_audits: at steady state the fleet
+drift must be exactly zero and the ring consistent.
+
+    make fleet-audit        # wired into `make check`
+    JAX_PLATFORMS=cpu python tools/fleet_audit_smoke.py
+
+Exit 0 on a conserved, ring-consistent fleet; 1 otherwise (the folded
+document is printed either way for diagnosis).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from gubernator_tpu import (Behavior, RateLimitRequest, fleet,  # noqa: E402
+                            cluster as cluster_mod)
+from gubernator_tpu.config import BehaviorConfig  # noqa: E402
+
+DAY = 24 * 3_600_000
+SETTLE_S = 15.0
+
+
+def main() -> int:
+    c = cluster_mod.start(3, behaviors=BehaviorConfig(
+        global_sync_wait_ms=40, global_broadcast_interval_ms=40,
+        global_timeout_ms=5000), cache_size=1 << 12)
+    try:
+        now = int(time.time() * 1000)
+        for i in range(3):
+            inst = c.instance_at(i)
+            reqs = [RateLimitRequest(
+                name="fleet_smoke", unique_key=f"k{j}", hits=1,
+                limit=10_000, duration=DAY, behavior=Behavior.GLOBAL)
+                for j in range(32)]
+            for _ in range(4):
+                inst.get_rate_limits(reqs, now_ms=now)
+        # settle: poke each daemon's flush loop until every vector
+        # drains (bounded — steady state must drain in one window)
+        deadline = time.monotonic() + SETTLE_S
+        docs = []
+        while time.monotonic() < deadline:
+            for i in range(3):
+                gm = c.instance_at(i).global_manager
+                if gm is not None:
+                    gm.poke()
+            time.sleep(0.2)
+            docs = [fetch_audit(c.http_address(i)) for i in range(3)]
+            if all(d["conserved"] for d in docs):
+                break
+        fold = fleet.fold_audits(docs)
+        fold["ring"] = fleet.ring_verdict(docs)
+        print(json.dumps(fold, indent=2))
+        ok = (fold["conserved"] and fold["ring"]["consistent"]
+              and fold["totals"]["injected"] > 0)
+        print(f"fleet-audit: drift={fold['drift']} "
+              f"injected={fold['totals']['injected']} "
+              f"ring={'ok' if fold['ring']['consistent'] else 'DIVERGED'}"
+              f" -> {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    finally:
+        c.stop()
+
+
+def fetch_audit(base: str) -> dict:
+    with urllib.request.urlopen(base.rstrip("/") + "/debug/audit",
+                                timeout=5.0) as f:
+        return json.loads(f.read())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

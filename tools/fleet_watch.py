@@ -1,0 +1,147 @@
+"""Fleet watchtower fan-in (ISSUE 19, fleet.py).
+
+Polls every daemon's debug endpoints and folds them through the exact
+fleet merges: conservation audit (Σ backlog == fleet drift), ring
+consistency (divergence printed as it fires/clears), cluster top-K,
+tenant RED rollup, SLO burn, memory pressure.  One shot by default;
+``--watch N`` loops every N seconds and edge-prints ring/conservation
+transitions — a terminal-grade stand-in for the fleet tick a real
+control plane would run.
+
+    python tools/fleet_watch.py --url http://d1:1050 --url http://d2:1050
+    python tools/fleet_watch.py --watch 5 --url ...    # follow mode
+    python tools/fleet_watch.py --json --url ...       # one JSON doc
+
+Exit: 0 when every daemon answered, the ring is consistent and the
+fleet is conserved; 1 otherwise (watch mode exits on interrupt with
+the last verdict).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+from gubernator_tpu import fleet  # noqa: E402
+
+#: endpoint → merge for the full sweep; audit is fetched first because
+#: status + ring checks fold it too
+ENDPOINTS = ("/debug/audit", "/healthz", "/debug/topkeys",
+             "/debug/tenants", "/debug/slo", "/debug/memory")
+
+
+def _fetch(url: str, timeout: float):
+    with urllib.request.urlopen(url, timeout=timeout) as f:
+        return json.loads(f.read())
+
+
+def _fan(urls, path, timeout):
+    """Per-daemon documents for one endpoint; None entries mark
+    unreachable daemons (the sweep continues — a dead daemon is a
+    finding, not a crash)."""
+    docs = []
+    for base in urls:
+        try:
+            docs.append(_fetch(base.rstrip("/") + path, timeout))
+        except Exception as e:  # noqa: BLE001
+            print(f"fetch failed ({base}{path}): {e!r}",
+                  file=sys.stderr)
+            docs.append(None)
+    return docs
+
+
+def sweep(urls, timeout: float, watch: fleet.RingWatch) -> dict:
+    """One fleet tick: fetch everything, fold everything."""
+    raw = {p: _fan(urls, p, timeout) for p in ENDPOINTS}
+    audits = [d for d in raw["/debug/audit"] if d]
+    health = [d or {"status": "unreachable"} for d in raw["/healthz"]]
+    out = {
+        "daemons": len(urls),
+        "reachable": sum(1 for d in raw["/debug/audit"] if d),
+        "status": fleet.merge_status(health, audits),
+        "audit": fleet.fold_audits(audits),
+        "ring": watch.check(audits),
+        "topkeys": fleet.merge_topkeys(
+            [d for d in raw["/debug/topkeys"] if d]),
+        "tenants": fleet.merge_tenants(
+            [d for d in raw["/debug/tenants"] if d]),
+        "slo": fleet.merge_slo([d for d in raw["/debug/slo"] if d]),
+        "memory": fleet.merge_memory(
+            [d for d in raw["/debug/memory"] if d]),
+    }
+    out["ok"] = (out["reachable"] == out["daemons"]
+                 and out["ring"]["consistent"]
+                 and out["audit"]["conserved"]
+                 and out["tenants"]["conserved"])
+    return out
+
+
+def render(doc: dict) -> None:
+    a, ring = doc["audit"], doc["ring"]
+    t = a["totals"]
+    state = "CONSERVED" if a["conserved"] else "DRIFT"
+    print(f"[fleet] {doc['reachable']}/{doc['daemons']} reachable  "
+          f"drift={a['drift']} ({state})  "
+          f"ring={'ok' if ring['consistent'] else 'DIVERGED'}  "
+          f"breached={doc['slo']['breached'] or 'none'}")
+    print(f"  injected={t['injected']} applied={t['applied']} "
+          f"queued={t['queued']} in_flight={t['in_flight']} "
+          f"lost={t['lost']}  max_drain_age={a['max_drain_age_s']}s "
+          f"(bound {a['bound_s']}s)")
+    for r in a["per_daemon"]:
+        print(f"    {r['instance'] or '?':<24} drift={r['drift']:<8} "
+              f"queued={r['queued']:<8} lost={r['lost']}")
+    if not ring["consistent"]:
+        print(f"  ring DIVERGED: {','.join(ring['reasons'])} "
+              f"ejected={ring['ejected']}")
+    keys = doc["topkeys"]["keys"][:5]
+    if keys:
+        tops = ", ".join(f"{e.get('key') or e['khash']}:{e['hits']}"
+                         for e in keys)
+        print(f"  top keys: {tops}")
+    mem = doc["memory"]
+    print(f"  memory: device={mem['device_bytes']} "
+          f"max_pressure={mem['max_pressure']}  tenants: "
+          f"{doc['tenants']['tenant_count']} "
+          f"({'sum-ok' if doc['tenants']['conserved'] else 'MISMATCH'})")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fan in every daemon's debug endpoints and fold "
+                    "them into the fleet verdict (fleet.py)")
+    ap.add_argument("--url", action="append", dest="urls", default=None,
+                    help="daemon HTTP base url (repeat per daemon; "
+                         "default http://localhost:1050)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                    help="loop every SEC seconds (edge-prints ring "
+                         "divergence transitions)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one folded JSON document per sweep")
+    args = ap.parse_args(argv)
+    urls = args.urls or ["http://localhost:1050"]
+    watch = fleet.RingWatch()
+    doc = None
+    try:
+        while True:
+            doc = sweep(urls, args.timeout, watch)
+            if args.json:
+                print(json.dumps(doc))
+            else:
+                render(doc)
+            if args.watch <= 0:
+                break
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        pass
+    return 0 if (doc and doc["ok"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
